@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Headline benchmark: FL rounds/sec on the flagship config.
+
+Config = BASELINE.json configs[1]: fmnist-shaped data, 10 agents, 1 corrupt,
+poison_frac=0.5, robustLR_threshold=4, local_ep=2, bs=256 (the paper's
+FMNIST attack+defense setting, src/runner.sh:18). Real FMNIST is used when
+present under ./data; otherwise the deterministic synthetic fallback with the
+same 60k x 28x28 geometry.
+
+Prints ONE JSON line:
+  {"metric": "fl_rounds_per_sec", "value": N, "unit": "rounds/sec",
+   "vs_baseline": N}
+
+vs_baseline is the speedup over the reference-semantics torch loop measured
+on this host (BASELINE_MEASURED.json, scripts/measure_reference_baseline.py):
+the reference trains sampled agents sequentially (src/federated.py:68-72), so
+its round time is agents * local_ep * batches * sec_per_batch_step.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
+                 num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
+                 synth_train_size=60000, synth_val_size=10000, seed=0)
+    log(f"[bench] devices: {jax.devices()}")
+
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, fed.train.images.shape[2:],
+                         jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    round_fn = make_round_fn(cfg, model, norm,
+                             jnp.asarray(fed.train.images),
+                             jnp.asarray(fed.train.labels),
+                             jnp.asarray(fed.train.sizes))
+
+    key = jax.random.PRNGKey(0)
+    # warmup / compile
+    t0 = time.perf_counter()
+    params, _ = round_fn(params, key)
+    jax.block_until_ready(params)
+    log(f"[bench] compile+first round: {time.perf_counter() - t0:.1f}s")
+
+    n_rounds = 10
+    t0 = time.perf_counter()
+    for r in range(n_rounds):
+        params, _ = round_fn(params, jax.random.fold_in(key, r))
+    jax.block_until_ready(params)
+    elapsed = time.perf_counter() - t0
+    rounds_per_sec = n_rounds / elapsed
+    log(f"[bench] {n_rounds} rounds in {elapsed:.2f}s "
+        f"-> {rounds_per_sec:.3f} rounds/sec")
+
+    vs_baseline = 1.0
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_MEASURED.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+        batches_per_agent = fed.train.images.shape[1] // cfg.bs
+        ref_round_sec = (cfg.agents_per_round * cfg.local_ep *
+                         batches_per_agent * base["sec_per_batch_step"])
+        vs_baseline = rounds_per_sec * ref_round_sec
+        log(f"[bench] reference-semantics round would take "
+            f"{ref_round_sec:.1f}s on this host's CPU -> "
+            f"speedup {vs_baseline:.1f}x")
+
+    print(json.dumps({"metric": "fl_rounds_per_sec",
+                      "value": round(rounds_per_sec, 4),
+                      "unit": "rounds/sec",
+                      "vs_baseline": round(vs_baseline, 2)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (  # noqa: F401
+    Stamp,
+    build_stamp,
+    apply_stamp,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack.poison import (  # noqa: F401
+    select_poison_idxs,
+    poison_agent_shards,
+    build_poisoned_val,
+)

@@ -1,0 +1,200 @@
+"""Trojan-pattern stamp library.
+
+Reference semantics (src/utils.py:181-284, `add_pattern_bd`) re-expressed as
+precomputed (mask, value, mode) stamps so the hot path is a single vectorized
+`jnp.where`/add instead of Python pixel loops. Exact geometry parity:
+
+fmnist (raw uint8 pixels, pre-normalization):
+  - square    : x[21:26, 21:26] = 255                       (utils.py:227-230)
+  - plus      : start=5, size=5; vertical col 5 rows 5..9;
+                horizontal row 7 cols 3..7; value 255        (utils.py:244-253)
+  - copyright / apple : additive inverted watermark, uint8 add *wraps mod 256*
+                (utils.py:232-242; quirk SURVEY.md 2.3.10, reproduced)
+
+fedemnist (float pixels, already normalized):
+  - square    : x[21:26, 21:26] = 0                          (utils.py:256-259)
+  - plus      : start=8, size=5; vertical col 8 rows 8..12;
+                horizontal row 10 cols 6..10; value 0        (utils.py:275-282)
+  - copyright / apple : x -= watermark/255                   (utils.py:261-273)
+
+cifar10 (raw uint8, all 3 channels; only 'plus' exists — other pattern types
+stamp nothing but poisoning still flips labels, as in the reference where
+`add_pattern_bd` falls through and `poison_dataset` relabels anyway):
+  - plus, agent_idx == -1 (full pattern, used for the poisoned val set):
+      vertical col 5 rows 5..11; horizontal row 8 cols 2..8  (utils.py:192-201)
+  - Distributed Backdoor Attack slices by agent_idx % 4      (utils.py:202-224):
+      0: vertical rows 5..8      1: vertical rows 9..11
+      2: horizontal cols 2..6    3: horizontal cols 5..8
+    value 0.
+
+Watermark assets: the reference loads `../watermark.png` / `../apple.png` with
+cv2 (utils.py:233-241). We look for them in `data_dir`; if absent we fall back
+to a deterministic procedural watermark so the pattern type stays functional
+in asset-free environments (documented divergence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+# stamp modes
+SET = "set"            # x[mask] = value
+ADD_WRAP_U8 = "addu8"  # x = uint8(x + value)  (wraps mod 256, quirk-parity)
+SUB_FLOAT = "subf"     # x = x - value
+
+
+@dataclasses.dataclass(frozen=True)
+class Stamp:
+    mode: str
+    mask: np.ndarray          # [H, W] bool — where the pattern applies
+    value: np.ndarray         # [H, W] float32 — pattern value / additive trojan
+
+    @property
+    def is_empty(self) -> bool:
+        return not bool(self.mask.any()) and self.mode == SET
+
+
+def _plus_mask(h: int, w: int, start: int, size: int,
+               vert_rows: range, horiz_cols: range) -> np.ndarray:
+    m = np.zeros((h, w), dtype=bool)
+    for i in vert_rows:
+        m[i, start] = True
+    for j in horiz_cols:
+        m[start + size // 2, j] = True
+    return m
+
+
+def _load_watermark(name: str, data_dir: str) -> Optional[np.ndarray]:
+    """cv2-load + invert + resize to 28x28, as utils.py:233-241."""
+    for base in (data_dir, ".", os.path.dirname(data_dir or ".")):
+        path = os.path.join(base or ".", name)
+        if os.path.exists(path):
+            try:
+                import cv2
+                img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
+                if img is None:
+                    continue
+                img = cv2.bitwise_not(img)
+                return cv2.resize(img, dsize=(28, 28),
+                                  interpolation=cv2.INTER_CUBIC).astype(np.float32)
+            except Exception:
+                continue
+    return None
+
+
+def _procedural_watermark(name: str) -> np.ndarray:
+    """Deterministic stand-in when the reference PNG assets are absent."""
+    rng = np.random.default_rng(abs(hash(name)) % (2 ** 31))
+    base = (rng.random((7, 7)) > 0.5).astype(np.float32) * 255.0
+    return np.kron(base, np.ones((4, 4), dtype=np.float32))  # 28x28 blocky mark
+
+
+def build_stamp(data: str, pattern_type: str, agent_idx: int = -1,
+                data_dir: str = "./data") -> Stamp:
+    """Build the (mask, value, mode) stamp for a dataset/pattern/DBA-slice combo.
+
+    `agent_idx=-1` is the full (unpartitioned) pattern — used for honest... no:
+    used for the poisoned *validation* set (src/federated.py:42-45); training
+    poisoning passes the corrupt agent's id (src/agent.py:19-25), which only
+    changes the geometry for cifar10 'plus' (the DBA split, utils.py:202-224).
+    """
+    if data == "fmnist":
+        h = w = 28
+        if pattern_type == "square":
+            m = np.zeros((h, w), dtype=bool)
+            m[21:26, 21:26] = True
+            return Stamp(SET, m, np.full((h, w), 255.0, np.float32))
+        if pattern_type == "plus":
+            start, size = 5, 5
+            m = _plus_mask(h, w, start, size,
+                           range(start, start + size),
+                           range(start - size // 2, start + size // 2 + 1))
+            return Stamp(SET, m, np.full((h, w), 255.0, np.float32))
+        if pattern_type in ("copyright", "apple"):
+            name = "watermark.png" if pattern_type == "copyright" else "apple.png"
+            troj = _load_watermark(name, data_dir)
+            if troj is None:
+                troj = _procedural_watermark(name)
+            return Stamp(ADD_WRAP_U8, np.ones((h, w), dtype=bool), troj)
+
+    elif data == "fedemnist":
+        h = w = 28
+        if pattern_type == "square":
+            m = np.zeros((h, w), dtype=bool)
+            m[21:26, 21:26] = True
+            return Stamp(SET, m, np.zeros((h, w), np.float32))
+        if pattern_type == "plus":
+            start, size = 8, 5
+            m = _plus_mask(h, w, start, size,
+                           range(start, start + size),
+                           range(start - size // 2, start + size // 2 + 1))
+            return Stamp(SET, m, np.zeros((h, w), np.float32))
+        if pattern_type in ("copyright", "apple"):
+            name = "watermark.png" if pattern_type == "copyright" else "apple.png"
+            troj = _load_watermark(name, data_dir)
+            if troj is None:
+                troj = _procedural_watermark(name)
+            return Stamp(SUB_FLOAT, np.ones((h, w), dtype=bool), troj / 255.0)
+
+    elif data in ("cifar10", "synthetic"):
+        h = w = 32 if data == "cifar10" else 8
+        m = np.zeros((h, w), dtype=bool)
+        if pattern_type == "plus" and data == "cifar10":
+            start, size = 5, 6
+            if agent_idx == -1:
+                for i in range(start, start + size + 1):
+                    m[i, start] = True
+                for j in range(start - size // 2, start + size // 2 + 1):
+                    m[start + size // 2, j] = True
+            elif agent_idx % 4 == 0:      # upper vertical (utils.py:205-208)
+                for i in range(start, start + size // 2 + 1):
+                    m[i, start] = True
+            elif agent_idx % 4 == 1:      # lower vertical (utils.py:210-214)
+                for i in range(start + size // 2 + 1, start + size + 1):
+                    m[i, start] = True
+            elif agent_idx % 4 == 2:      # left horizontal (utils.py:216-219)
+                for j in range(start - size // 2, start + size // 4 + 1):
+                    m[start + size // 2, j] = True
+            else:                          # right horizontal (utils.py:221-224)
+                for j in range(start - size // 4 + 1, start + size // 2 + 1):
+                    m[start + size // 2, j] = True
+        elif data == "synthetic":
+            # small-image stand-in pattern: 3x3 corner block set to max
+            m[:3, :3] = True
+            return Stamp(SET, m, np.full((h, w), 255.0, np.float32))
+        # cifar10 with a non-plus pattern: empty stamp (labels still flip,
+        # matching the reference fall-through, utils.py:188-224)
+        return Stamp(SET, m, np.zeros((h, w), np.float32))
+
+    raise ValueError(f"no stamp for data={data!r} pattern={pattern_type!r}")
+
+
+def apply_stamp(x, stamp: Stamp):
+    """Apply a stamp to images shaped [..., H, W, C] (numpy or jax arrays).
+
+    Works under jit: mask/value are compile-time constants. Input may be raw
+    uint8 (fmnist/cifar10) or float (fedemnist); output dtype == input dtype
+    for SET/ADD_WRAP_U8, float for SUB_FLOAT on float input.
+    """
+    import jax.numpy as jnp
+
+    is_np = isinstance(x, np.ndarray)
+    xp = np if is_np else jnp
+    mask = stamp.mask[..., None]            # [H, W, 1] broadcast over channels
+    if stamp.mode == SET:
+        val = stamp.value[..., None].astype(np.float32)
+        out = xp.where(mask, val.astype(x.dtype), x)
+        return out
+    if stamp.mode == ADD_WRAP_U8:
+        troj = stamp.value[..., None].astype(np.uint8)
+        out = (x.astype(xp.uint8) + troj)   # uint8 add wraps mod 256
+        return xp.where(mask, out, x).astype(x.dtype)
+    if stamp.mode == SUB_FLOAT:
+        troj = stamp.value[..., None].astype(np.float32)
+        out = x.astype(xp.float32) - troj
+        return xp.where(mask, out, x.astype(xp.float32))
+    raise ValueError(stamp.mode)

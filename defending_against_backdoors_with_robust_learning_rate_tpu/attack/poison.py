@@ -1,0 +1,81 @@
+"""Backdoor poisoning over agent-stacked arrays.
+
+Reference behavior (src/utils.py:160-178 `poison_dataset`, src/agent.py:19-25):
+the first `num_corrupt` agents poison their local slice at construction time —
+`floor(poison_frac * |base-class idxs in slice|)` uniformly-sampled samples get
+the trojan stamped onto the *raw stored pixels* (pre-normalization) and the
+label flipped to `target_class`. The poisoned validation set is every
+base-class val sample, fully trojaned (`poison_all=True`, full pattern
+`agent_idx=-1`), relabeled (src/federated.py:42-45).
+
+TPU-native differences:
+- index selection is host-side, deterministic under a seeded numpy Generator
+  (reference uses unseeded `random.sample`, utils.py:166; SURVEY.md 2.3.12);
+- the stamp itself is a vectorized transform (attack/patterns.py) that can be
+  applied either host-side at setup or on-device under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (
+    Stamp, build_stamp, apply_stamp)
+
+
+def select_poison_idxs(labels: np.ndarray, base_class: int, frac: float,
+                       rng: np.random.Generator,
+                       valid: np.ndarray | None = None) -> np.ndarray:
+    """Uniform sample of floor(frac * count) base-class indices (utils.py:161-166)."""
+    cand = labels == base_class
+    if valid is not None:
+        cand = cand & valid
+    cand_idxs = np.nonzero(cand)[0]
+    k = math.floor(frac * len(cand_idxs))
+    if k == 0:
+        return np.zeros((0,), dtype=np.int64)
+    return rng.choice(cand_idxs, size=k, replace=False)
+
+
+def poison_agent_shards(images: np.ndarray, labels: np.ndarray,
+                        sizes: np.ndarray, cfg, *,
+                        seed_offset: int = 1234) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Poison the local slices of the first cfg.num_corrupt agents, in place
+    on copies of the stacked arrays.
+
+    images: [K, max_n, H, W, C] raw pixels; labels: [K, max_n]; sizes: [K].
+    Returns (images, labels, poison_mask[K, max_n]).
+    """
+    images = images.copy()
+    labels = labels.copy()
+    K, max_n = labels.shape
+    poison_mask = np.zeros((K, max_n), dtype=bool)
+    for aid in range(min(cfg.num_corrupt, K)):
+        stamp = build_stamp(cfg.data, cfg.pattern_type, agent_idx=aid,
+                            data_dir=cfg.data_dir)
+        rng = np.random.default_rng(cfg.seed + seed_offset + aid)
+        valid = np.arange(max_n) < sizes[aid]
+        idxs = select_poison_idxs(labels[aid], cfg.base_class, cfg.poison_frac,
+                                  rng, valid=valid)
+        if len(idxs) == 0:
+            continue
+        images[aid, idxs] = np.asarray(
+            apply_stamp(images[aid, idxs], stamp)).astype(images.dtype)
+        labels[aid, idxs] = cfg.target_class
+        poison_mask[aid, idxs] = True
+    return images, labels, poison_mask
+
+
+def build_poisoned_val(val_images: np.ndarray, val_labels: np.ndarray,
+                       cfg) -> Tuple[np.ndarray, np.ndarray]:
+    """All base-class val samples, fully trojaned and relabeled
+    (src/federated.py:42-45 with poison_all=True, agent_idx=-1)."""
+    idxs = np.nonzero(val_labels == cfg.base_class)[0]
+    stamp = build_stamp(cfg.data, cfg.pattern_type, agent_idx=-1,
+                        data_dir=cfg.data_dir)
+    imgs = np.asarray(apply_stamp(val_images[idxs], stamp)).astype(val_images.dtype)
+    lbls = np.full((len(idxs),), cfg.target_class, dtype=val_labels.dtype)
+    return imgs, lbls

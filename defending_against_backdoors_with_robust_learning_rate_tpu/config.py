@@ -1,0 +1,213 @@
+"""Experiment configuration: flag-compatible CLI over a frozen dataclass.
+
+Flag-name/default parity with the reference CLI (reference: src/options.py:4-74,
+20 flags). Differences, all deliberate and documented:
+
+- ``--device`` (reference: src/options.py:67-68 picks cuda:0/cpu) is replaced by
+  TPU-native placement flags ``--mesh`` and ``--platform``; ``--device`` is still
+  accepted and ignored (with a warning) so reference command lines keep working.
+- ``--num_workers`` (DataLoader threads, reference: src/options.py:70-71) is
+  accepted and ignored: data is device-resident, there is no loader.
+- New flags: ``--seed`` (the reference is unseeded, SURVEY.md 2.3.12; we add
+  determinism), ``--arch`` (BASELINE.json configs[3-4] require ResNet-9 on
+  cifar10 in addition to the faithful CNN), ``--dtype`` (bf16 compute on the
+  MXU, f32 default for curve parity), ``--data_dir``, ``--log_dir``,
+  ``--checkpoint_dir``/``--resume`` (SURVEY.md section 5.4: checkpointing is
+  absent in the reference and added here), ``--mesh`` (number of devices on the
+  ``agents`` mesh axis; 0 = all local devices, 1 = single-device vmap path).
+
+Semantics preserved exactly (reference: src/federated.py:23): ``server_lr`` is
+forced to 1.0 unless ``aggr == 'sign'``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # --- reference flag surface (names + defaults match src/options.py) ---
+    data: str = "fmnist"            # fmnist | cifar10 | fedemnist | synthetic
+    num_agents: int = 10            # K
+    agent_frac: float = 1.0         # C, fraction of agents sampled per round
+    num_corrupt: int = 0            # first num_corrupt agent ids are malicious
+    rounds: int = 200               # R communication rounds
+    aggr: str = "avg"               # avg | comed | sign | krum
+    local_ep: int = 2               # E local epochs
+    bs: int = 256                   # B local batch size
+    client_lr: float = 0.1
+    client_moment: float = 0.9
+    server_lr: float = 1.0          # only used as-is for aggr='sign'
+    base_class: int = 5             # backdoor source class
+    target_class: int = 7           # backdoor target class
+    poison_frac: float = 0.0        # fraction of base-class samples to trojan
+    pattern_type: str = "plus"      # plus | square | copyright | apple
+    robustLR_threshold: int = 0     # >0 enables the RLR defense
+    clip: float = 0.0               # >0 enables client-side PGD L2 projection
+    noise: float = 0.0              # >0 adds N(0, noise*clip) server noise
+    top_frac: int = 100             # sign-agreement diagnostic top-k params
+    snap: int = 1                   # eval every `snap` rounds
+
+    # --- TPU-native additions ---
+    platform: str = ""              # "" = default backend; "cpu"/"tpu" override
+    seed: int = 0
+    arch: str = "auto"              # auto | cnn | resnet9
+    dtype: str = "f32"              # f32 | bf16 (compute dtype on the MXU)
+    mesh: int = 1                   # devices on the `agents` mesh axis; 0 = all
+    data_dir: str = "./data"
+    log_dir: str = "./logs"
+    checkpoint_dir: str = ""        # "" disables checkpointing
+    resume: bool = False
+    eval_bs: int = 1024
+    profile_dir: str = ""           # "" disables jax.profiler traces
+    use_pallas: bool = False        # fused RLR+aggregate TPU kernel
+    tensorboard: bool = True        # JSONL metrics always; TB optional
+    # synthetic-data knobs (used when `data` is missing on disk or 'synthetic')
+    synth_train_size: int = 2048
+    synth_val_size: int = 512
+
+    @property
+    def effective_server_lr(self) -> float:
+        """server_lr is forced to 1.0 unless aggr=='sign' (src/federated.py:23)."""
+        return self.server_lr if self.aggr == "sign" else 1.0
+
+    @property
+    def agents_per_round(self) -> int:
+        """floor(K * C) sampled agents per round (src/federated.py:68)."""
+        import math
+
+        return max(1, math.floor(self.num_agents * self.agent_frac))
+
+    @property
+    def n_classes(self) -> int:
+        # the reference hardcodes 10 everywhere, incl. fedemnist eval
+        # (src/utils.py:128, SURVEY.md 2.3.7); we keep 10 for parity.
+        return 10
+
+    @property
+    def image_shape(self):
+        if self.data in ("fmnist", "fedemnist"):
+            return (28, 28, 1)
+        if self.data in ("cifar10", "synthetic"):
+            return (32, 32, 3) if self.data == "cifar10" else (8, 8, 1)
+        raise ValueError(f"unknown dataset {self.data!r}")
+
+    @property
+    def model_arch(self) -> str:
+        if self.arch != "auto":
+            return self.arch
+        return "cnn"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def _add_reference_flags(p: argparse.ArgumentParser) -> None:
+    d = Config()
+    p.add_argument("--data", type=str, default=d.data,
+                   help="dataset we want to train on")
+    p.add_argument("--num_agents", type=int, default=d.num_agents,
+                   help="number of agents:K")
+    p.add_argument("--agent_frac", type=float, default=d.agent_frac,
+                   help="fraction of agents per round:C")
+    p.add_argument("--num_corrupt", type=int, default=d.num_corrupt,
+                   help="number of corrupt agents")
+    p.add_argument("--rounds", type=int, default=d.rounds,
+                   help="number of communication rounds:R")
+    p.add_argument("--aggr", type=str, default=d.aggr,
+                   help="aggregation function (avg|comed|sign|krum)")
+    p.add_argument("--local_ep", type=int, default=d.local_ep,
+                   help="number of local epochs:E")
+    p.add_argument("--bs", type=int, default=d.bs, help="local batch size: B")
+    p.add_argument("--client_lr", type=float, default=d.client_lr,
+                   help="clients learning rate")
+    p.add_argument("--client_moment", type=float, default=d.client_moment,
+                   help="clients momentum")
+    p.add_argument("--server_lr", type=float, default=d.server_lr,
+                   help="servers learning rate for signSGD")
+    p.add_argument("--base_class", type=int, default=d.base_class,
+                   help="base class for backdoor attack")
+    p.add_argument("--target_class", type=int, default=d.target_class,
+                   help="target class for backdoor attack")
+    p.add_argument("--poison_frac", type=float, default=d.poison_frac,
+                   help="fraction of dataset to corrupt for backdoor attack")
+    p.add_argument("--pattern_type", type=str, default=d.pattern_type,
+                   help="shape of bd pattern")
+    p.add_argument("--robustLR_threshold", type=int, default=d.robustLR_threshold,
+                   help="break ties when votes sum to 0")
+    p.add_argument("--clip", type=float, default=d.clip,
+                   help="weight clip to -clip,+clip")
+    p.add_argument("--noise", type=float, default=d.noise,
+                   help="server-side gaussian noise std multiplier (times clip)")
+    p.add_argument("--top_frac", type=int, default=d.top_frac,
+                   help="compare fraction of signs")
+    p.add_argument("--snap", type=int, default=d.snap,
+                   help="do inference in every num of snap rounds")
+    # accepted-and-ignored reference flags (GPU-loop specific)
+    p.add_argument("--device", type=str, default=None,
+                   help="[ignored] reference GPU selector; use --mesh/--platform")
+    p.add_argument("--num_workers", type=int, default=0,
+                   help="[ignored] reference DataLoader workers; data is device-resident")
+
+
+def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
+    d = Config()
+    p.add_argument("--platform", type=str, default=d.platform,
+                   help="jax platform override (cpu|tpu); empty = default")
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--arch", type=str, default=d.arch,
+                   help="auto|cnn|resnet9 (BASELINE.json configs[3-4])")
+    p.add_argument("--dtype", type=str, default=d.dtype, help="f32|bf16")
+    p.add_argument("--mesh", type=int, default=d.mesh,
+                   help="devices on the `agents` mesh axis (0=all local devices)")
+    p.add_argument("--data_dir", type=str, default=d.data_dir)
+    p.add_argument("--log_dir", type=str, default=d.log_dir)
+    p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval_bs", type=int, default=d.eval_bs)
+    p.add_argument("--profile_dir", type=str, default=d.profile_dir)
+    p.add_argument("--use_pallas", action="store_true")
+    p.add_argument("--no_tensorboard", action="store_true")
+    p.add_argument("--synth_train_size", type=int, default=d.synth_train_size)
+    p.add_argument("--synth_val_size", type=int, default=d.synth_val_size)
+
+
+def args_parser(argv: Optional[list] = None) -> Config:
+    """Parse CLI flags into a Config (reference: src/options.py:4-74)."""
+    p = argparse.ArgumentParser(
+        description="TPU-native robust-learning-rate federated learning")
+    _add_reference_flags(p)
+    _add_tpu_flags(p)
+    ns = p.parse_args(argv)
+    if ns.device is not None:
+        print(f"[config] --device={ns.device} ignored: placement is TPU-mesh "
+              f"native, use --mesh / JAX_PLATFORMS")
+    fields = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in vars(ns).items() if k in fields}
+    kw["tensorboard"] = not ns.no_tensorboard
+    return Config(**kw)
+
+
+def print_exp_details(cfg: Config) -> None:
+    """Banner matching the reference (src/utils.py:287-303)."""
+    print("======================================")
+    print(f"    Dataset: {cfg.data}")
+    print(f"    Global Rounds: {cfg.rounds}")
+    print(f"    Aggregation Function: {cfg.aggr}")
+    print(f"    Number of agents: {cfg.num_agents}")
+    print(f"    Fraction of agents: {cfg.agent_frac}")
+    print(f"    Batch size: {cfg.bs}")
+    print(f"    Client_LR: {cfg.client_lr}")
+    print(f"    Server_LR: {cfg.effective_server_lr}")
+    print(f"    Client_Momentum: {cfg.client_moment}")
+    print(f"    RobustLR_threshold: {cfg.robustLR_threshold}")
+    print(f"    Noise Ratio: {cfg.noise}")
+    print(f"    Number of corrupt agents: {cfg.num_corrupt}")
+    print(f"    Poison Frac: {cfg.poison_frac}")
+    print(f"    Clip: {cfg.clip}")
+    print(f"    Seed: {cfg.seed}  Arch: {cfg.model_arch}  Dtype: {cfg.dtype}"
+          f"  Mesh: {cfg.mesh}")
+    print("======================================")

@@ -1,0 +1,13 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (  # noqa: F401
+    RawDataset,
+    FederatedData,
+    get_datasets,
+    get_federated_data,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.partition import (  # noqa: F401
+    distribute_data,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (  # noqa: F401
+    AgentShards,
+    stack_agent_shards,
+)

@@ -1,0 +1,10 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (  # noqa: F401
+    make_local_train,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (  # noqa: F401
+    make_round_fn,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (  # noqa: F401
+    make_eval_fn,
+    pad_eval_set,
+)

@@ -1,0 +1,91 @@
+"""Client local training — the reference's `Agent.local_train`
+(src/agent.py:33-64) as a pure jittable function.
+
+Reference semantics preserved:
+- fresh SGD(momentum) state every round (src/agent.py:37; momentum buffer
+  starts at zero — SURVEY.md 7.3.4);
+- `local_ep` epochs, reshuffled each epoch (DataLoader shuffle=True,
+  src/agent.py:28), last batch partial;
+- per-minibatch global-grad-norm clip to 10 (src/agent.py:50);
+- optional per-minibatch PGD projection of the cumulative update onto the
+  L2 ball `clip` (src/agent.py:54-60, inside the batch loop — SURVEY.md 2.3.3);
+- dropout active during local training;
+- returns the flat update (final - initial); f32 here instead of the
+  reference's f64 (SURVEY.md 2.3.2).
+
+TPU-native shape discipline: the agent's shard is padded to `n_batches * bs`;
+every agent runs an identical trace (`lax.scan` over epochs x batches). A
+random shuffle sorts real samples in front of padding, so batch b's samples
+are real iff their shuffled position < size; fully-padded batches are exact
+no-ops (masked optimizer step). This function is `vmap`ped over the sampled
+agents on one chip and `shard_map`ped over the `agents` mesh axis at scale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+    masked_ce)
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.sgd import (
+    clip_by_global_norm, pgd_project, sgd_momentum_step)
+
+
+def make_local_train(model, cfg, normalize):
+    """Returns local_train(params0, images, labels, size, key) -> update pytree.
+
+    images: [n_total, H, W, C] raw pixels, n_total a multiple of cfg.bs;
+    labels: [n_total] int32; size: scalar int32 true shard size; key: PRNGKey.
+    """
+    bs = cfg.bs
+
+    def local_train(params0, images, labels, size, key):
+        n_total = images.shape[0]
+        nb = n_total // bs
+        params0 = tree.astype(params0, jnp.float32)
+
+        def epoch_body(carry, ep_key):
+            params, mom = carry
+            shuffle_key, drop_key = jax.random.split(ep_key)
+            r = jax.random.uniform(shuffle_key, (n_total,))
+            r = jnp.where(jnp.arange(n_total) < size, r, 2.0)
+            perm = jnp.argsort(r)          # real samples first, shuffled
+
+            def batch_body(carry, b):
+                params, mom = carry
+                idx = jax.lax.dynamic_slice(perm, (b * bs,), (bs,))
+                x = jnp.take(images, idx, axis=0)
+                y = jnp.take(labels, idx, axis=0)
+                w = (b * bs + jnp.arange(bs)) < size
+
+                def loss_fn(p):
+                    logits = model.apply(
+                        {"params": p}, normalize(x), train=True,
+                        rngs={"dropout": jax.random.fold_in(drop_key, b)})
+                    return masked_ce(logits, y, w)
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                grads = clip_by_global_norm(grads, 10.0)
+                w_n = jnp.sum(w)
+                params, mom = sgd_momentum_step(
+                    params, mom, grads, cfg.client_lr, cfg.client_moment,
+                    w_n > 0)
+                if cfg.clip > 0:
+                    params = pgd_project(params, params0, cfg.clip)
+                return (params, mom), (loss * w_n, w_n)
+
+            (params, mom), (loss_sums, w_sums) = jax.lax.scan(
+                batch_body, (params, mom), jnp.arange(nb))
+            # sample-weighted epoch loss: padding batches contribute nothing
+            ep_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
+            return (params, mom), ep_loss
+
+        ep_keys = jax.random.split(key, cfg.local_ep)
+        (params, _), ep_losses = jax.lax.scan(
+            epoch_body, (params0, tree.zeros_like(params0)), ep_keys)
+        update = tree.sub(params, params0)
+        return update, jnp.mean(ep_losses)
+
+    return local_train

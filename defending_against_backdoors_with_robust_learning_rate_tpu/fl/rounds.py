@@ -1,0 +1,91 @@
+"""The FL round step — one jitted function per round.
+
+Reference: the round loop body of src/federated.py:65-74 (sequential Python
+loop over sampled agents, dict of updates, in-process aggregation). Here the
+whole round is ONE compiled XLA program: client sampling
+(`jax.random.permutation`, replacing the unseeded np.random.choice at
+src/federated.py:68), a `vmap` over the m sampled agents' local training, the
+aggregation rule + RLR defense, and the global parameter update. No snapshot/
+restore dance (src/federated.py:66-72) is needed because local training is a
+pure function of the global params.
+
+Two data modes:
+- device-resident (fmnist/cifar10): all K agent shards live in HBM; the
+  sampled m shards are gathered *inside* jit.
+- host-sampled (fedemnist, 3383 users): the driver gathers the sampled
+  shards on host and feeds them as arguments (fixed [m, ...] shapes, so one
+  compilation serves every round).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
+    make_local_train)
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+    aggregate_updates, apply_aggregate, robust_lr)
+
+
+def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
+                local_train, cfg):
+    """Shared round body: vmapped local training + aggregation + update."""
+    m = imgs.shape[0]
+    agent_keys = jax.random.split(k_train, m)
+    updates, losses = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
+        params, imgs, lbls, sizes, agent_keys)
+    if cfg.robustLR_threshold > 0:
+        lr = robust_lr(updates, float(cfg.robustLR_threshold),
+                       cfg.effective_server_lr)
+    else:
+        lr = cfg.effective_server_lr
+    agg = aggregate_updates(updates, sizes, cfg, k_noise)
+    new_params = apply_aggregate(params, lr, agg)
+    return new_params, jnp.mean(losses)
+
+
+def make_round_fn(cfg, model, normalize, images, labels, sizes):
+    """Device-resident round fn: round(params, key) -> (params, metrics).
+
+    images/labels/sizes are the full K-agent stacked arrays (jnp, on device).
+    """
+    local_train = make_local_train(model, cfg, normalize)
+    K, m = cfg.num_agents, cfg.agents_per_round
+
+    @jax.jit
+    def round_fn(params, key):
+        # key-derivation order matches parallel/rounds.py so the sharded and
+        # single-device paths are comparable round-for-round
+        k_sample, k_train, k_noise = jax.random.split(key, 3)
+        sampled = jax.random.permutation(k_sample, K)[:m]
+        imgs = jnp.take(images, sampled, axis=0)
+        lbls = jnp.take(labels, sampled, axis=0)
+        szs = jnp.take(sizes, sampled, axis=0)
+        new_params, train_loss = _round_core(
+            params, k_train, k_noise, imgs, lbls, szs,
+            local_train=local_train, cfg=cfg)
+        return new_params, {"train_loss": train_loss, "sampled": sampled}
+
+    return round_fn
+
+
+def make_round_fn_host(cfg, model, normalize):
+    """Host-sampled round fn: round(params, key, imgs, lbls, sizes).
+
+    The driver samples agent ids and gathers their shards host-side (the
+    fedemnist path: 3383 users, 1% sampled per round, src/runner.sh:34)."""
+    local_train = make_local_train(model, cfg, normalize)
+
+    @jax.jit
+    def round_fn(params, key, imgs, lbls, sizes):
+        k_train, k_noise = jax.random.split(key)
+        new_params, train_loss = _round_core(
+            params, k_train, k_noise, imgs, lbls, sizes,
+            local_train=local_train, cfg=cfg)
+        return new_params, {"train_loss": train_loss}
+
+    return round_fn

@@ -1,0 +1,12 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.cnn import (  # noqa: F401
+    CNN_MNIST,
+    CNN_CIFAR,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.resnet import (  # noqa: F401
+    ResNet9,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (  # noqa: F401
+    get_model,
+    init_params,
+    param_count,
+)

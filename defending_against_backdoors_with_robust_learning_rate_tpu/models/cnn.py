@@ -1,0 +1,68 @@
+"""Faithful Flax re-expressions of the reference CNNs (src/models.py:11-58).
+
+Shape parity (VALID convs, 2x2 maxpool, same widths/dropout rate):
+
+CNN_MNIST (src/models.py:11-31), ~1.2M params:
+  28x28x1 -conv3x3(32)-> 26 -conv3x3(64)-> 24 -pool2-> 12 -> flatten 9216
+  -> dropout(.5) -> fc 128 -> relu -> dropout(.5) -> fc 10
+
+CNN_CIFAR (src/models.py:33-58), ~0.9M params:
+  32x32x3 -conv(64)+pool-> 15 -conv(128)+pool-> 6 -conv(256)+pool-> 2
+  -> flatten 1024 -> dropout -> fc 128 -> relu -> dropout -> fc 256 -> relu
+  -> dropout -> fc 10
+  (the reference's `fc1 = Linear(64*4*4, 128)` coincidentally equals the true
+  flatten size 256*2*2 = 1024, SURVEY.md C14 quirk)
+
+Differences, deliberate: NHWC layout (TPU-native) so the flatten ordering is
+HWC-major rather than torch's CHW-major — identical parameter counts and
+function class, not bit-identical weight layout. The reference's `Dropout2d`
+on already-flattened 2D tensors degenerates to per-feature dropout, which is
+what `nn.Dropout` does here. Inputs of arbitrary HxW are supported (the
+synthetic fallback uses small images); flatten size adapts.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNN_MNIST(nn.Module):
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.n_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class CNN_CIFAR(nn.Module):
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        for width in (64, 128, 256):
+            x = nn.relu(nn.Conv(width, (3, 3), padding="VALID",
+                                dtype=self.dtype)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(256, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.n_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

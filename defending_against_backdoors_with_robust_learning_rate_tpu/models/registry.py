@@ -1,0 +1,37 @@
+"""Model registry (reference: `get_model`, src/models.py:4-8)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.cnn import (
+    CNN_MNIST, CNN_CIFAR)
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.resnet import (
+    ResNet9)
+
+_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
+def get_model(data: str, arch: str = "cnn", dtype: str = "f32",
+              n_classes: int = 10):
+    """fmnist/fedemnist -> CNN_MNIST; cifar10 -> CNN_CIFAR (src/models.py:4-8);
+    arch='resnet9' selects the BASELINE north-star ResNet-9 extension."""
+    dt = _DTYPES[dtype]
+    if arch == "resnet9":
+        return ResNet9(n_classes=n_classes, dtype=dt)
+    if data in ("fmnist", "fedemnist", "synthetic"):
+        return CNN_MNIST(n_classes=n_classes, dtype=dt)
+    if data == "cifar10":
+        return CNN_CIFAR(n_classes=n_classes, dtype=dt)
+    raise ValueError(f"no model for data={data!r} arch={arch!r}")
+
+
+def init_params(model, image_shape, key=None, batch: int = 2):
+    key = key if key is not None else jax.random.PRNGKey(0)
+    x = jnp.zeros((batch,) + tuple(image_shape), jnp.float32)
+    return model.init({"params": key}, x, train=False)["params"]
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,68 @@
+"""ResNet-9 for the BASELINE.json north-star configs[3-4] (cifar10 at scale).
+
+The reference has no ResNet (its CIFAR model is a 3-conv CNN, src/models.py:
+33-58); BASELINE.json explicitly asks for "cifar10 ResNet-9" (SURVEY.md
+2.3.11), so this is a framework extension. Design choices, TPU/FL-native:
+
+- GroupNorm instead of BatchNorm: the reference's models have no BN (so the
+  flat-parameter-vector currency carries no running stats); GroupNorm keeps
+  that property — all state is parameters, so FedAvg/comed/sign/RLR apply
+  unchanged to every tensor — and avoids cross-client BN-statistic leakage.
+- NHWC, 3x3 SAME convs, classic DAWNBench ResNet-9 topology:
+  conv(64) -> conv(128)+pool -> residual(128) -> conv(256)+pool
+  -> conv(512)+pool -> residual(512) -> global maxpool -> fc, output scaled
+  by 0.125 (the standard ResNet-9 logit scale).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvGN(nn.Module):
+    width: int
+    pool: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.width),
+                         dtype=self.dtype)(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class Residual(nn.Module):
+    width: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = ConvGN(self.width, dtype=self.dtype)(x)
+        y = ConvGN(self.width, dtype=self.dtype)(y)
+        return x + y
+
+
+class ResNet9(nn.Module):
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvGN(64, dtype=self.dtype)(x)
+        x = ConvGN(128, pool=True, dtype=self.dtype)(x)
+        x = Residual(128, dtype=self.dtype)(x)
+        x = ConvGN(256, pool=True, dtype=self.dtype)(x)
+        x = ConvGN(512, pool=True, dtype=self.dtype)(x)
+        x = Residual(512, dtype=self.dtype)(x)
+        x = jnp.max(x, axis=(1, 2))          # global max pool
+        x = nn.Dense(self.n_classes, dtype=self.dtype)(x)
+        return (x * 0.125).astype(jnp.float32)

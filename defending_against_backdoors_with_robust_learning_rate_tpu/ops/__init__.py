@@ -1,0 +1,16 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree  # noqa: F401
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.sgd import (  # noqa: F401
+    sgd_momentum_step,
+    clip_by_global_norm,
+    pgd_project,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (  # noqa: F401
+    robust_lr,
+    agg_avg,
+    agg_comed,
+    agg_sign,
+    agg_krum,
+    gaussian_noise_like,
+    aggregate_updates,
+    apply_aggregate,
+)

@@ -1,0 +1,135 @@
+"""Server aggregation rules + the robust-learning-rate (RLR) defense.
+
+Reference: src/aggregation.py. Updates arrive stacked on a leading agent axis
+(`[m, ...]` per pytree leaf) instead of a Python dict of flat vectors
+(src/federated.py:67-74); every rule is a `tree_map`ped reduction over axis 0,
+which XLA lowers to the same math the flat-vector version computes.
+
+- `robust_lr`   (src/aggregation.py:48-54): per coordinate,
+    s = |sum_k sign(u_k)|; lr = +server_lr where s >= threshold else -server_lr.
+  The vote is unweighted and runs over exactly the sampled agents
+  (SURVEY.md 2.3.5) — callers pass the m sampled updates, so the effective
+  vote count matches the reference's per-round participant count.
+- `agg_avg`     (src/aggregation.py:57-64): data-size-weighted mean.
+- `agg_comed`   (src/aggregation.py:66-69): per-coordinate median over agents.
+- `agg_sign`    (src/aggregation.py:71-75): sign of the sum of signs (the
+  reference double-signs; idempotent, SURVEY.md 2.3.6).
+- `agg_krum`    : NOT in the reference (avg/comed/sign only) — required by
+  BASELINE.json configs[4]; standard Krum (Blanchard et al., NeurIPS 2017):
+  each update scores the sum of its m-f-2 smallest squared distances to the
+  others; the minimizer is returned.
+- server noise  (src/aggregation.py:34-35): N(0, noise*clip) added to the
+  aggregate.
+- `apply_aggregate` (src/aggregation.py:38-40): global += lr ⊙ aggregate.
+
+Precision: the reference accumulates in float64 (src/agent.py:63); TPU has no
+fast f64, we use f32 throughout (documented divergence, SURVEY.md 2.3.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+
+
+def robust_lr(stacked_updates, threshold: float, server_lr: float):
+    """Per-parameter learning-rate tree: +server_lr where the sign-agreement
+    vote reaches `threshold`, else -server_lr (src/aggregation.py:48-54)."""
+    def leaf(u):
+        s = jnp.abs(jnp.sum(jnp.sign(u), axis=0))
+        return jnp.where(s >= threshold, server_lr, -server_lr).astype(jnp.float32)
+    return tree.map(leaf, stacked_updates)
+
+
+def agg_avg(stacked_updates, data_sizes):
+    """Weighted FedAvg: sum_k n_k u_k / sum_k n_k (src/aggregation.py:57-64)."""
+    w = data_sizes.astype(jnp.float32)
+    total = jnp.sum(w)
+
+    def leaf(u):
+        wshape = (-1,) + (1,) * (u.ndim - 1)
+        return jnp.sum(u * w.reshape(wshape), axis=0) / total
+    return tree.map(leaf, stacked_updates)
+
+
+def agg_comed(stacked_updates):
+    """Per-coordinate median over the agent axis (src/aggregation.py:66-69).
+
+    With an even agent count this matches torch.median (lower of the two
+    middle values), NOT numpy's midpoint interpolation."""
+    m = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
+
+    def leaf(u):
+        srt = jnp.sort(u, axis=0)
+        return srt[(m - 1) // 2]
+    return tree.map(leaf, stacked_updates)
+
+
+def agg_sign(stacked_updates):
+    """Majority-sign update: sign(sum_k sign(u_k)) (src/aggregation.py:71-75)."""
+    return tree.map(lambda u: jnp.sign(jnp.sum(jnp.sign(u), axis=0)),
+                    stacked_updates)
+
+
+def _pairwise_sq_dists(stacked_updates):
+    """[m, m] matrix of squared L2 distances summed across all leaves."""
+    leaves = jax.tree_util.tree_leaves(stacked_updates)
+    m = leaves[0].shape[0]
+    d = jnp.zeros((m, m), jnp.float32)
+    for u in leaves:
+        flat = u.reshape(m, -1).astype(jnp.float32)
+        sq = jnp.sum(flat * flat, axis=1)
+        d = d + sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+    return jnp.maximum(d, 0.0)
+
+
+def agg_krum(stacked_updates, num_corrupt: int = 0):
+    """Krum: select the update with the smallest sum of its m-f-2 nearest
+    squared distances (framework extension; BASELINE.json configs[4])."""
+    d = _pairwise_sq_dists(stacked_updates)
+    m = d.shape[0]
+    k = max(m - num_corrupt - 2, 1)
+    # distance to self is 0 and sorts first; take the next k columns
+    srt = jnp.sort(d, axis=1)
+    scores = jnp.sum(srt[:, 1:k + 1], axis=1)
+    best = jnp.argmin(scores)
+    return tree.map(lambda u: u[best], stacked_updates)
+
+
+def gaussian_noise_like(params_like, key, std: float):
+    """Server DP noise N(0, std) per coordinate (src/aggregation.py:34-35)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params_like)
+    keys = jax.random.split(key, len(leaves))
+    noisy = [jax.random.normal(k, x.shape, jnp.float32) * std
+             for k, x in zip(keys, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, noisy)
+
+
+def aggregate_updates(stacked_updates, data_sizes, cfg, key):
+    """Dispatch on cfg.aggr + optional noise (src/aggregation.py:26-35)."""
+    if cfg.aggr == "avg":
+        agg = agg_avg(stacked_updates, data_sizes)
+    elif cfg.aggr == "comed":
+        agg = agg_comed(stacked_updates)
+    elif cfg.aggr == "sign":
+        agg = agg_sign(stacked_updates)
+    elif cfg.aggr == "krum":
+        agg = agg_krum(stacked_updates, cfg.num_corrupt)
+    else:
+        raise ValueError(f"unknown aggr {cfg.aggr!r}")
+    if cfg.noise > 0:
+        agg = tree.add(agg, gaussian_noise_like(agg, key,
+                                                cfg.noise * cfg.clip))
+    return agg
+
+
+def apply_aggregate(params, lr_tree_or_scalar, aggregated):
+    """global <- global + lr ⊙ aggregate, f32 (src/aggregation.py:38-40)."""
+    lr = lr_tree_or_scalar
+    if isinstance(lr, (int, float)) or (hasattr(lr, "ndim") and lr.ndim == 0):
+        new = tree.map(lambda p, a: p + lr * a, params, aggregated)
+    else:
+        new = tree.map(lambda p, l, a: p + l * a, params, lr, aggregated)
+    return tree.astype(new, jnp.float32)

@@ -1,0 +1,46 @@
+"""Client-side optimizer ops with reference (torch) semantics.
+
+- SGD with momentum, no dampening/nesterov (src/agent.py:37-38):
+    buf <- mu * buf + g ;  p <- p - lr * buf
+  A fresh optimizer is created per agent per round (src/agent.py:37), i.e.
+  momentum starts at zero every round (SURVEY.md 7.3.4) — callers must pass a
+  zero buffer at round start.
+- Global-grad-norm clip to 10 (src/agent.py:50, torch `clip_grad_norm_`
+  semantics incl. the 1e-6 epsilon).
+- Per-batch PGD projection of the cumulative update onto the L2 ball of
+  radius `clip` (src/agent.py:54-60) — note this runs inside the minibatch
+  loop, after every step (SURVEY.md 2.3.3).
+
+All ops take a `valid` scalar so fully-padded batches are exact no-ops
+(params AND momentum unchanged) — padding batches exist because every agent
+runs the same trace length on TPU while the reference simply has fewer
+batches for smaller shards.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+
+
+def clip_by_global_norm(grads, max_norm: float = 10.0):
+    gnorm = tree.norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+    return tree.scale(grads, scale)
+
+
+def sgd_momentum_step(params, momentum, grads, lr: float, mu: float, valid):
+    """One masked torch-SGD step. `valid` True -> real batch; False -> no-op."""
+    new_momentum = tree.map(lambda b, g: mu * b + g, momentum, grads)
+    new_params = tree.map(lambda p, b: p - lr * b, params, new_momentum)
+    return (tree.where(valid, new_params, params),
+            tree.where(valid, new_momentum, momentum))
+
+
+def pgd_project(params, params0, clip: float):
+    """Project (params - params0) onto the L2 ball of radius `clip`
+    (src/agent.py:54-60: denom = max(1, ||update||/clip))."""
+    update = tree.sub(params, params0)
+    denom = jnp.maximum(1.0, tree.norm(update) / clip)
+    return tree.add(params0, tree.scale(update, 1.0 / denom))

@@ -1,0 +1,53 @@
+"""Pytree arithmetic helpers.
+
+The reference's currency is a flat 1-D parameter vector
+(`parameters_to_vector`, SURVEY.md section 1); the TPU-native currency is the
+Flax param pytree end-to-end — elementwise aggregation math is `tree_map`ped,
+and flattening (`ravel_pytree`) exists only at the parity-test boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree  # noqa: F401  (re-export)
+
+map = jax.tree_util.tree_map
+
+
+def add(a, b):
+    return map(jnp.add, a, b)
+
+
+def sub(a, b):
+    return map(jnp.subtract, a, b)
+
+
+def scale(a, s):
+    return map(lambda x: x * s, a)
+
+
+def mul(a, b):
+    """Elementwise tree*tree (e.g. per-parameter RLR lr vector)."""
+    return map(jnp.multiply, a, b)
+
+
+def zeros_like(a):
+    return map(jnp.zeros_like, a)
+
+
+def sq_norm(a):
+    return sum(jnp.sum(jnp.square(x)) for x in jax.tree_util.tree_leaves(a))
+
+
+def norm(a):
+    return jnp.sqrt(sq_norm(a))
+
+
+def where(flag, a, b):
+    """Select whole-tree a or b by a scalar bool (used to mask no-op steps)."""
+    return map(lambda x, y: jnp.where(flag, x, y), a, b)
+
+
+def astype(a, dtype):
+    return map(lambda x: x.astype(dtype), a)

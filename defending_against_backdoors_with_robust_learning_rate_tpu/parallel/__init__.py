@@ -1,0 +1,7 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    pick_agent_mesh_size,
+)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (  # noqa: F401
+    make_sharded_round_fn,
+)

@@ -1,0 +1,189 @@
+"""The experiment driver — reference src/federated.py:21-95 re-built around
+jitted round functions.
+
+Round loop shape (reference src/federated.py:65-92): sample agents -> local
+training -> aggregate -> eval every `snap` rounds, logging the reference's
+exact TensorBoard scalar names. Differences: the whole round is one compiled
+XLA program (vmap on one device, shard_map over the `agents` mesh axis when
+--mesh > 1); client sampling is seeded; checkpoint/resume via Orbax
+(SURVEY.md section 5.4 gap); rounds/sec throughput is measured (section 5.1
+gap, and BASELINE.json's headline metric)."""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+    Config, args_parser, print_exp_details)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+    get_federated_data)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+    make_normalizer)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
+    make_eval_fn, pad_eval_set)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+    make_round_fn, make_round_fn_host)
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+    get_model, init_params, param_count)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+    checkpoint as ckpt)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+    MetricsWriter, run_name)
+
+# above this many stacked-array bytes the driver switches to host-side
+# per-round shard gathering (the fedemnist path: 3383 users, SURVEY.md 7.3.2)
+DEVICE_RESIDENT_BYTES = 2 << 30
+
+
+def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
+    print_exp_details(cfg)
+    fed = get_federated_data(cfg)
+    if fed.synthetic and cfg.data != "synthetic":
+        print(f"[data] {cfg.data} files not found under {cfg.data_dir!r}; "
+              f"using the deterministic synthetic fallback")
+
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, fed.train.images.shape[2:],
+                         jax.random.PRNGKey(cfg.seed))
+    print(f"[model] {type(model).__name__}: {param_count(params):,} params")
+    if cfg.use_pallas:
+        print("[pallas] fused RLR+aggregate kernel not wired into the round "
+              "path yet in this version; --use_pallas ignored")
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+
+    host_mode = fed.train.images.nbytes > DEVICE_RESIDENT_BYTES
+    n_mesh = 1
+    if cfg.mesh != 1 and not host_mode:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+            make_mesh, pick_agent_mesh_size)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+            make_sharded_round_fn)
+        n_mesh = pick_agent_mesh_size(cfg.mesh, cfg.agents_per_round)
+    if n_mesh > 1:
+        mesh = make_mesh(n_mesh)
+        print(f"[mesh] {n_mesh} devices on the `agents` axis "
+              f"({cfg.agents_per_round // n_mesh} agents/device)")
+        round_fn = make_sharded_round_fn(
+            cfg, model, norm, mesh, jnp.asarray(fed.train.images),
+            jnp.asarray(fed.train.labels), jnp.asarray(fed.train.sizes))
+        host_sampler = None
+    elif host_mode:
+        print(f"[data] host-sampled mode "
+              f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
+        if cfg.mesh != 1:
+            print("[mesh] host-sampled mode is single-device in this "
+                  "version; --mesh request ignored")
+        round_fn_host = make_round_fn_host(cfg, model, norm)
+
+        def host_sampler(params, key, rnd):
+            # per-round generator so --resume continues the same sampling
+            # sequence the uninterrupted run would have used
+            rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
+            ids = rng.choice(cfg.num_agents, cfg.agents_per_round,
+                             replace=False)
+            return round_fn_host(
+                params, key,
+                jnp.asarray(fed.train.images[ids]),
+                jnp.asarray(fed.train.labels[ids]),
+                jnp.asarray(fed.train.sizes[ids]))
+    else:
+        round_fn = make_round_fn(cfg, model, norm,
+                                 jnp.asarray(fed.train.images),
+                                 jnp.asarray(fed.train.labels),
+                                 jnp.asarray(fed.train.sizes))
+        host_sampler = None
+
+    eval_fn = make_eval_fn(model, norm, cfg.n_classes)
+    val = tuple(map(jnp.asarray, pad_eval_set(
+        fed.val_images, fed.val_labels, cfg.eval_bs)))
+    pval = tuple(map(jnp.asarray, pad_eval_set(
+        fed.pval_images, fed.pval_labels, cfg.eval_bs)))
+
+    if writer is None:
+        writer = MetricsWriter(cfg.log_dir, run_name(cfg), cfg.tensorboard)
+
+    base_key = jax.random.PRNGKey(cfg.seed)
+    start_round, cum_poison_acc = 0, 0.0
+    if cfg.resume and cfg.checkpoint_dir:
+        restored = ckpt.restore(cfg.checkpoint_dir, params)
+        if restored is not None:
+            start_round, params, base_key, cum_poison_acc = restored
+            params = jax.device_put(params)
+            print(f"[ckpt] resumed from round {start_round}")
+
+    if cfg.profile_dir:
+        jax.profiler.start_trace(cfg.profile_dir)
+
+    summary: Dict = {}
+    t_loop = time.perf_counter()
+    rounds_done = 0
+    for rnd in range(start_round + 1, cfg.rounds + 1):
+        key = jax.random.fold_in(base_key, rnd)
+        if host_sampler is not None:
+            params, info = host_sampler(params, key, rnd)
+        else:
+            params, info = round_fn(params, key)
+        rounds_done += 1
+
+        if rnd % cfg.snap == 0:
+            val_loss, val_acc, per_class = eval_fn(params, *val)
+            poison_loss, poison_acc, _ = eval_fn(params, *pval)
+            val_loss, val_acc = float(val_loss), float(val_acc)
+            poison_loss, poison_acc = float(poison_loss), float(poison_acc)
+            cum_poison_acc += poison_acc
+            # scalar names preserved from src/federated.py:81-91
+            writer.scalar("Validation/Loss", val_loss, rnd)
+            writer.scalar("Validation/Accuracy", val_acc, rnd)
+            writer.scalar("Poison/Base_Class_Accuracy",
+                          float(per_class[cfg.base_class]), rnd)
+            writer.scalar("Poison/Poison_Accuracy", poison_acc, rnd)
+            writer.scalar("Poison/Poison_Loss", poison_loss, rnd)
+            writer.scalar("Poison/Cumulative_Poison_Accuracy_Mean",
+                          cum_poison_acc / rnd, rnd)
+            writer.scalar("Train/Loss", float(info["train_loss"]), rnd)
+            elapsed = time.perf_counter() - t_loop
+            writer.scalar("Throughput/Rounds_Per_Sec",
+                          rounds_done / elapsed, rnd)
+            print(f'| Rnd {rnd}: Val_Loss/Val_Acc: {val_loss:.3f} / '
+                  f'{val_acc:.3f} |')
+            print(f'| Rnd {rnd}: Poison Loss/Poison Acc: {poison_loss:.3f} / '
+                  f'{poison_acc:.3f} |')
+            summary = {"round": rnd, "val_loss": val_loss, "val_acc": val_acc,
+                       "poison_loss": poison_loss, "poison_acc": poison_acc,
+                       "rounds_per_sec": rounds_done / elapsed}
+            if cfg.checkpoint_dir:
+                ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
+                          cum_poison_acc)
+        writer.flush()
+
+    if cfg.profile_dir:
+        jax.profiler.stop_trace()
+
+    elapsed = time.perf_counter() - t_loop
+    summary.setdefault("round", cfg.rounds)
+    summary["rounds_per_sec"] = rounds_done / max(elapsed, 1e-9)
+    summary["params"] = param_count(params)
+    print("Training has finished!")
+    print(f"[throughput] {summary['rounds_per_sec']:.3f} rounds/sec "
+          f"({rounds_done} rounds in {elapsed:.1f}s)")
+    writer.close()
+    return summary
+
+
+def main(argv=None):
+    cfg = args_parser(argv)
+    if cfg.platform:
+        # must land before any backend use; this environment's sitecustomize
+        # pins a platform at interpreter start, so env vars alone are too late
+        jax.config.update("jax_platforms", cfg.platform)
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (  # noqa: F401
+    MetricsWriter,
+)

@@ -1,0 +1,61 @@
+"""Orbax checkpoint / resume.
+
+The reference has NO checkpointing (SURVEY.md section 5.4: a killed 500-round
+run restarts from scratch). The build adds it: (global params, round, PRNG
+key, cumulative poison accuracy) saved every `snap` rounds, restored with
+``--resume``."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save(ckpt_dir: str, rnd: int, params, key, cum_poison_acc: float) -> None:
+    path = os.path.join(os.path.abspath(ckpt_dir), f"round_{rnd:06d}")
+    state = {
+        "params": jax.device_get(params),
+        "round": np.asarray(rnd, np.int64),
+        "key": np.asarray(jax.device_get(jax.random.key_data(key))),
+        "cum_poison_acc": np.asarray(cum_poison_acc, np.float64),
+    }
+    ckptr = _ckptr()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+
+
+def latest_round(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    # only complete checkpoints: a kill mid-save leaves
+    # round_NNNNNN.orbax-checkpoint-tmp-* directories behind
+    rounds = [int(m.group(1)) for d in os.listdir(ckpt_dir)
+              if (m := re.fullmatch(r"round_(\d+)", d))]
+    return max(rounds) if rounds else None
+
+
+def restore(ckpt_dir: str, params_like) -> Optional[Tuple[int, Any, Any, float]]:
+    """Returns (round, params, key, cum_poison_acc) or None."""
+    rnd = latest_round(ckpt_dir)
+    if rnd is None:
+        return None
+    path = os.path.join(os.path.abspath(ckpt_dir), f"round_{rnd:06d}")
+    key_shape = jax.random.key_data(jax.random.PRNGKey(0)).shape
+    target = {
+        "params": jax.device_get(params_like),
+        "round": np.asarray(0, np.int64),
+        "key": np.zeros(key_shape, np.uint32),
+        "cum_poison_acc": np.asarray(0.0, np.float64),
+    }
+    state = _ckptr().restore(path, target)
+    key = jax.random.wrap_key_data(state["key"])
+    return int(state["round"]), state["params"], key, float(state["cum_poison_acc"])

@@ -1,0 +1,66 @@
+"""Metrics / observability.
+
+Reference: TensorBoard SummaryWriter with a hyperparameter-derived run name
+(src/federated.py:27-31) and seven scalar series (src/federated.py:81-91).
+Scalar names are preserved exactly — curve parity against the reference's
+TensorBoard output is the acceptance test (SURVEY.md section 5.5):
+
+    Validation/Loss, Validation/Accuracy,
+    Poison/Base_Class_Accuracy, Poison/Poison_Accuracy, Poison/Poison_Loss,
+    Poison/Cumulative_Poison_Accuracy_Mean
+
+Additions: a JSONL sink (always on — greppable, no TB dependency) and
+rounds/sec throughput scalars (SURVEY.md section 5.1: the reference has no
+profiling; BASELINE's metric is FL rounds/sec)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+def run_name(cfg) -> str:
+    """Hyperparam-derived run dir name (src/federated.py:27-31, minus the
+    duplicated num_corrupt quirk, SURVEY.md 2.3.9)."""
+    return (f"time:{time.ctime().replace(' ', '_')}-clip_val:{cfg.clip}"
+            f"-noise_std:{cfg.noise}-aggr:{cfg.aggr}"
+            f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
+            f"-thrs_robustLR:{cfg.robustLR_threshold}"
+            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}")
+
+
+class MetricsWriter:
+    """JSONL always; TensorBoard when available and enabled."""
+
+    def __init__(self, log_dir: str, name: Optional[str] = None,
+                 tensorboard: bool = True):
+        os.makedirs(log_dir, exist_ok=True)
+        self.dir = os.path.join(log_dir, name) if name else log_dir
+        os.makedirs(self.dir, exist_ok=True)
+        self._jsonl = open(os.path.join(self.dir, "metrics.jsonl"), "a")
+        self._tb = None
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(self.dir)
+            except Exception:
+                self._tb = None
+
+    def scalar(self, tag: str, value, step: int) -> None:
+        self._jsonl.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+        if self._tb is not None:
+            self._tb.add_scalar(tag, float(value), step)
+
+    def flush(self) -> None:
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""CLI entry point — `python federated.py --flags`, the reference's invocation
+surface (src/runner.sh:12-38) with identical flag names and defaults."""
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.train import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Measure a reference-semantics PyTorch baseline on THIS host.
+
+The reference repo publishes no throughput numbers (BASELINE.md: "published":
+{}), and no NVIDIA GPU exists here, so the recorded baseline is the
+reference's training loop re-expressed in torch (sequential agents, SGD +
+clip + CE — src/agent.py:41-51 semantics) timed on this host's CPU. We record
+*seconds per minibatch step* so bench.py can derive an equivalent
+reference round time for any config:
+
+    ref_round_time = agents_per_round * local_ep * batches_per_agent * sec_per_step
+
+Writes BASELINE_MEASURED.json at the repo root.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import torch
+
+
+class TorchCNNMnist(torch.nn.Module):
+    """Reference CNN_MNIST topology (src/models.py:11-31)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 32, 3)
+        self.conv2 = torch.nn.Conv2d(32, 64, 3)
+        self.pool = torch.nn.MaxPool2d(2)
+        self.fc1 = torch.nn.Linear(9216, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+        self.drop = torch.nn.Dropout(0.5)
+
+    def forward(self, x):
+        x = torch.relu(self.conv1(x))
+        x = torch.relu(self.conv2(x))
+        x = self.pool(x).flatten(1)
+        x = self.drop(x)
+        x = torch.relu(self.fc1(x))
+        x = self.drop(x)
+        return self.fc2(x)
+
+
+def main():
+    bs = 256
+    n_steps = 8
+    torch.manual_seed(0)
+    model = TorchCNNMnist()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    crit = torch.nn.CrossEntropyLoss()
+    x = torch.randn(bs, 1, 28, 28)
+    y = torch.randint(0, 10, (bs,))
+
+    # warmup
+    for _ in range(2):
+        opt.zero_grad()
+        crit(model(x), y).backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 10)
+        opt.step()
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        opt.zero_grad()
+        crit(model(x), y).backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 10)
+        opt.step()
+    sec_per_step = (time.perf_counter() - t0) / n_steps
+
+    out = {
+        "sec_per_batch_step": sec_per_step,
+        "model": "CNN_MNIST",
+        "bs": bs,
+        "device": "cpu",
+        "threads": torch.get_num_threads(),
+        "note": ("reference-semantics torch loop (src/agent.py:41-51) timed "
+                 "on this host's CPU; the reference publishes no numbers and "
+                 "no NVIDIA GPU is available here"),
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BASELINE_MEASURED.json")
+    with open(os.path.abspath(path), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Canonical experiment triples, mirroring the reference runner
+# (src/runner.sh:12-38): {no-attack, attack, attack+RLR} for each dataset.
+# One process owns the whole device mesh (no cuda:N pinning / backgrounding);
+# sweeping = run these sequentially or as separate jobs.
+set -e
+cd "$(dirname "$0")/.."
+
+MESH=${MESH:-0}        # 0 = all local devices on the `agents` axis
+
+# ------------------------------- FMNIST (src/runner.sh:12-18) --------------
+python federated.py --data=fmnist --local_ep=2 --bs=256 --num_agents=10 --rounds=200 --mesh=$MESH "$@"
+python federated.py --data=fmnist --local_ep=2 --bs=256 --num_agents=10 --rounds=200 --num_corrupt=1 --poison_frac=0.5 --mesh=$MESH "$@"
+python federated.py --data=fmnist --local_ep=2 --bs=256 --num_agents=10 --rounds=200 --num_corrupt=1 --poison_frac=0.5 --robustLR_threshold=4 --mesh=$MESH "$@"
+
+# ------------------------------- CIFAR-10 DBA (src/runner.sh:23-28) --------
+python federated.py --data=cifar10 --num_agents=40 --rounds=200 --mesh=$MESH "$@"
+python federated.py --data=cifar10 --num_agents=40 --rounds=200 --num_corrupt=4 --poison_frac=0.5 --mesh=$MESH "$@"
+python federated.py --data=cifar10 --num_agents=40 --rounds=200 --num_corrupt=4 --poison_frac=0.5 --robustLR_threshold=8 --mesh=$MESH "$@"
+
+# ------------------------------- Fed-EMNIST (src/runner.sh:34-38) ----------
+python federated.py --data=fedemnist --num_agents=3383 --agent_frac=0.01 --local_ep=10 --bs=64 --rounds=500 --snap=5 --mesh=$MESH "$@"
+python federated.py --data=fedemnist --num_agents=3383 --agent_frac=0.01 --local_ep=10 --bs=64 --rounds=500 --snap=5 --num_corrupt=338 --poison_frac=0.5 --mesh=$MESH "$@"
+python federated.py --data=fedemnist --num_agents=3383 --agent_frac=0.01 --local_ep=10 --bs=64 --rounds=500 --snap=5 --num_corrupt=338 --poison_frac=0.5 --robustLR_threshold=8 --mesh=$MESH "$@"

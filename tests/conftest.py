@@ -1,0 +1,20 @@
+"""Test harness: fake 8-device CPU mesh (SURVEY.md section 4).
+
+Distributed-without-a-cluster via `--xla_force_host_platform_device_count=8`,
+the standard JAX trick for exercising shard_map/psum collectives in CI with
+no TPU. This environment's sitecustomize pins the `axon` TPU platform at
+interpreter startup, so env vars alone are too late — we override through
+jax.config before any backend is initialized."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# exact f32 matmuls for parity tests (TPU-style bf16 accumulation otherwise)
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,140 @@
+"""Unit tests for the numeric building blocks: SGD/clip/PGD parity with torch
+semantics, aggregation rules, and the RLR defense (src/aggregation.py:48-75)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+    agg_avg, agg_comed, agg_krum, agg_sign, aggregate_updates, apply_aggregate,
+    robust_lr)
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.sgd import (
+    clip_by_global_norm, pgd_project, sgd_momentum_step)
+
+
+def _tree(*arrays):
+    return {f"w{i}": jnp.asarray(a, jnp.float32) for i, a in enumerate(arrays)}
+
+
+# ------------------------------------------------------------------- sgd ---
+
+def test_clip_matches_torch_clip_grad_norm():
+    rng = np.random.default_rng(0)
+    g1, g2 = rng.normal(size=(5, 3)) * 4, rng.normal(size=(7,)) * 4
+    ours = clip_by_global_norm(_tree(g1, g2), 2.0)
+
+    t1 = torch.nn.Parameter(torch.zeros(5, 3))
+    t2 = torch.nn.Parameter(torch.zeros(7))
+    t1.grad = torch.tensor(g1, dtype=torch.float32)
+    t2.grad = torch.tensor(g2, dtype=torch.float32)
+    torch.nn.utils.clip_grad_norm_([t1, t2], 2.0)
+    np.testing.assert_allclose(ours["w0"], t1.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(ours["w1"], t2.grad.numpy(), rtol=1e-5)
+
+
+def test_sgd_momentum_matches_torch_over_steps():
+    """torch SGD(momentum, no dampening): buf = mu*buf + g; p -= lr*buf —
+    fresh optimizer per round (src/agent.py:37-38)."""
+    rng = np.random.default_rng(1)
+    p0 = rng.normal(size=(4, 2))
+    grads = [rng.normal(size=(4, 2)) for _ in range(5)]
+
+    tp = torch.nn.Parameter(torch.tensor(p0, dtype=torch.float32))
+    opt = torch.optim.SGD([tp], lr=0.1, momentum=0.9)
+    for g in grads:
+        opt.zero_grad()
+        tp.grad = torch.tensor(g, dtype=torch.float32)
+        opt.step()
+
+    params = _tree(p0)
+    mom = tree.zeros_like(params)
+    for g in grads:
+        params, mom = sgd_momentum_step(params, mom, _tree(g), 0.1, 0.9,
+                                        jnp.bool_(True))
+    np.testing.assert_allclose(params["w0"], tp.detach().numpy(), rtol=1e-5)
+
+
+def test_sgd_masked_step_is_noop():
+    params = _tree(np.ones((3,)))
+    mom = _tree(np.full((3,), 0.5))
+    p2, m2 = sgd_momentum_step(params, mom, _tree(np.ones((3,))), 0.1, 0.9,
+                               jnp.bool_(False))
+    np.testing.assert_array_equal(p2["w0"], params["w0"])
+    np.testing.assert_array_equal(m2["w0"], mom["w0"])
+
+
+def test_pgd_project():
+    p0 = _tree(np.zeros((4,)))
+    p = _tree(np.full((4,), 3.0))          # ||update|| = 6
+    out = pgd_project(p, p0, 2.0)          # scaled to norm 2
+    np.testing.assert_allclose(float(tree.norm(tree.sub(out, p0))), 2.0,
+                               rtol=1e-5)
+    out2 = pgd_project(out, p0, 2.0)       # inside the ball: no-op
+    np.testing.assert_allclose(out2["w0"], out["w0"], rtol=1e-6)
+
+
+# ----------------------------------------------------------- aggregation ---
+
+def test_robust_lr_rule():
+    """RLR (src/aggregation.py:48-54): |sum of signs| >= thr -> +lr else -lr."""
+    u = jnp.asarray([[1.0, 1.0, -1.0, 0.0],
+                     [2.0, -1.0, -3.0, 0.0],
+                     [0.5, 1.0, -2.0, 0.0],
+                     [4.0, -2.0, 5.0, 0.0]])
+    lr = robust_lr({"w": u}, threshold=3.0, server_lr=1.0)["w"]
+    # sums of signs: 4, -? (1-1+1-1=0), (-1-1-1+1=-2)->2, 0
+    np.testing.assert_array_equal(np.asarray(lr), [1.0, -1.0, -1.0, -1.0])
+
+
+def test_agg_avg_weighted():
+    u = {"w": jnp.asarray([[1.0, 2.0], [3.0, 6.0]])}
+    out = agg_avg(u, jnp.asarray([1.0, 3.0]))["w"]
+    np.testing.assert_allclose(out, [(1 + 9) / 4, (2 + 18) / 4])
+
+
+def test_agg_comed_matches_torch_median():
+    rng = np.random.default_rng(2)
+    for m in (3, 4, 7, 8):
+        u = rng.normal(size=(m, 13)).astype(np.float32)
+        ours = np.asarray(agg_comed({"w": jnp.asarray(u)})["w"])
+        theirs = torch.median(torch.tensor(u), dim=0).values.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+
+
+def test_agg_sign():
+    u = {"w": jnp.asarray([[1.0, -2.0, 0.0], [3.0, -1.0, 0.0],
+                           [-1.0, -5.0, 0.0]])}
+    np.testing.assert_array_equal(np.asarray(agg_sign(u)["w"]),
+                                  [1.0, -1.0, 0.0])
+
+
+def test_agg_krum_drops_outlier():
+    rng = np.random.default_rng(3)
+    honest = rng.normal(0, 0.1, size=(5, 20)).astype(np.float32)
+    outlier = np.full((1, 20), 50.0, np.float32)
+    u = {"w": jnp.asarray(np.concatenate([outlier, honest]))}
+    out = np.asarray(agg_krum(u, num_corrupt=1)["w"])
+    # the selected update must be one of the honest ones
+    assert np.abs(out).max() < 1.0
+
+
+def test_apply_aggregate_with_lr_tree():
+    params = _tree(np.zeros((3,)))
+    agg = _tree(np.asarray([1.0, 2.0, 3.0]))
+    lr = _tree(np.asarray([1.0, -1.0, 1.0]))
+    out = apply_aggregate(params, lr, agg)
+    np.testing.assert_allclose(out["w0"], [1.0, -2.0, 3.0])
+    out2 = apply_aggregate(params, 2.0, agg)
+    np.testing.assert_allclose(out2["w0"], [2.0, 4.0, 6.0])
+
+
+def test_noise_added_when_enabled():
+    cfg = Config(aggr="avg", noise=1.0, clip=0.5)
+    u = {"w": jnp.zeros((4, 100))}
+    out = aggregate_updates(u, jnp.ones((4,)), cfg, jax.random.PRNGKey(0))
+    std = float(jnp.std(out["w"]))
+    assert 0.3 < std < 0.7      # N(0, noise*clip=0.5)

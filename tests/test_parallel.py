@@ -1,0 +1,79 @@
+"""Sharded-vs-single-device parity on a faked 8-device CPU mesh
+(SURVEY.md section 4: distributed-without-a-cluster)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+    get_federated_data)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+    make_normalizer)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+    make_round_fn)
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+    get_model, init_params)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+    make_mesh, pick_agent_mesh_size)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+    make_sharded_round_fn)
+
+
+def test_pick_agent_mesh_size():
+    assert pick_agent_mesh_size(8, 10, n_devices=8) == 5   # m=10 on v5e-8
+    assert pick_agent_mesh_size(8, 8, n_devices=8) == 8
+    assert pick_agent_mesh_size(0, 33, n_devices=8) == 3   # fedemnist m=33
+    assert pick_agent_mesh_size(1, 7, n_devices=8) == 1
+
+
+def _setup(aggr, num_corrupt=1):
+    cfg = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
+                 synth_train_size=256, synth_val_size=64, aggr=aggr,
+                 num_corrupt=num_corrupt, poison_frac=1.0,
+                 robustLR_threshold=3 if aggr in ("avg", "sign") else 0,
+                 seed=11)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+    return cfg, model, params, norm, arrays
+
+
+@pytest.mark.parametrize("aggr", ["avg", "comed", "sign", "krum"])
+def test_sharded_round_matches_vmap_round(aggr):
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+    cfg, model, params, norm, arrays = _setup(aggr)
+    key = jax.random.PRNGKey(42)
+
+    single = make_round_fn(cfg, model, norm, *arrays)
+    p1, info1 = single(params, key)
+
+    mesh = make_mesh(8)
+    sharded = make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
+    p2, info2 = sharded(params, key)
+
+    np.testing.assert_array_equal(np.asarray(info1["sampled"]),
+                                  np.asarray(info2["sampled"]))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(info1["train_loss"]),
+                               float(info2["train_loss"]), rtol=1e-4)
+
+
+def test_sharded_multiround_trains():
+    cfg, model, params, norm, arrays = _setup("avg", num_corrupt=0)
+    mesh = make_mesh(4)
+    sharded = make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for r in range(4):
+        key, sub = jax.random.split(key)
+        params, info = sharded(params, sub)
+        losses.append(float(info["train_loss"]))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,48 @@
+"""Partitioner semantics vs the reference algorithm (src/utils.py:58-92)."""
+
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.partition import (
+    distribute_data)
+
+
+def test_single_agent_gets_everything():
+    labels = np.array([0, 1, 2, 3] * 10)
+    groups = distribute_data(labels, 1)
+    assert list(groups[0]) == list(range(40))
+
+
+def test_shards_disjoint_and_balanced():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, size=1000)
+    groups = distribute_data(labels, 10)
+    all_idxs = [i for g in groups.values() for i in g]
+    assert len(all_idxs) == len(set(all_idxs))       # no index dealt twice
+    for a in range(10):
+        # each agent receives class_per_agent=10 chunks of ~n/(K*10) each
+        assert len(groups[a]) > 0
+        assert set(groups[a]).issubset(set(range(1000)))
+
+
+def test_reference_dealing_order():
+    """Hand-check the chunk-deal on a tiny exactly-divisible case.
+
+    n=40, 2 classes' worth of labels spread over 10 classes is messy; use
+    n_classes=2, K=2, class_per_agent=2: shard_size = 40//(2*2) = 10,
+    slice_size = (40//2)//10 = 2 -> each class's sorted index list is split
+    into 2 strided chunks; agent 0 takes chunk0 of class0 and chunk0 of
+    class1, agent 1 takes the remaining chunks."""
+    labels = np.array([0] * 20 + [1] * 20)
+    groups = distribute_data(labels, 2, n_classes=2, class_per_agent=2)
+    c0 = list(range(0, 20))
+    c1 = list(range(20, 40))
+    assert sorted(groups[0]) == sorted(c0[0::2] + c1[0::2])
+    assert sorted(groups[1]) == sorted(c0[1::2] + c1[1::2])
+
+
+def test_agents_see_all_classes_iid_default():
+    rng = np.random.default_rng(1)
+    labels = rng.permutation(np.repeat(np.arange(10), 100))
+    groups = distribute_data(labels, 5)
+    for a in range(5):
+        assert set(labels[groups[a]]) == set(range(10))

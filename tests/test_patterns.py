@@ -1,0 +1,92 @@
+"""Golden-geometry tests for the trojan stamps (src/utils.py:181-284)."""
+
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (
+    build_stamp, apply_stamp)
+
+
+def _coords(mask):
+    return set(map(tuple, np.argwhere(mask)))
+
+
+def test_fmnist_square():
+    # x[21:26, 21:26] = 255 (utils.py:227-230)
+    s = build_stamp("fmnist", "square")
+    expect = {(i, j) for i in range(21, 26) for j in range(21, 26)}
+    assert _coords(s.mask) == expect
+    x = np.zeros((28, 28, 1), np.uint8)
+    out = apply_stamp(x, s)
+    assert out.dtype == np.uint8
+    assert (np.asarray(out)[21:26, 21:26, 0] == 255).all()
+    assert np.asarray(out).sum() == 255 * 25
+
+
+def test_fmnist_plus():
+    # start=5 size=5: vertical rows 5..9 col 5; horizontal row 7 cols 3..7
+    s = build_stamp("fmnist", "plus")
+    expect = {(i, 5) for i in range(5, 10)} | {(7, j) for j in range(3, 8)}
+    assert _coords(s.mask) == expect
+
+
+def test_fedemnist_plus_black():
+    # start=8 size=5, value 0 on pre-normalized floats (utils.py:275-282)
+    s = build_stamp("fedemnist", "plus")
+    expect = {(i, 8) for i in range(8, 13)} | {(10, j) for j in range(6, 11)}
+    assert _coords(s.mask) == expect
+    x = np.ones((4, 28, 28, 1), np.float32)
+    out = np.asarray(apply_stamp(x, s))
+    assert (out[:, 10, 6:11, 0] == 0).all()
+    assert out[0, 0, 0, 0] == 1.0
+
+
+def test_cifar_full_plus():
+    # vertical col 5 rows 5..11; horizontal row 8 cols 2..8 (utils.py:192-201)
+    s = build_stamp("cifar10", "plus", agent_idx=-1)
+    expect = {(i, 5) for i in range(5, 12)} | {(8, j) for j in range(2, 9)}
+    assert _coords(s.mask) == expect
+    x = np.full((1, 32, 32, 3), 200, np.uint8)
+    out = np.asarray(apply_stamp(x, s))
+    assert (out[0, 8, 2:9] == 0).all()          # all three channels
+    assert out[0, 0, 0, 0] == 200
+
+
+def test_cifar_dba_slices_union_is_full_pattern():
+    # DBA partitioning by agent_idx % 4 (utils.py:202-224)
+    full = build_stamp("cifar10", "plus", agent_idx=-1).mask
+    union = np.zeros_like(full)
+    slices = []
+    for a in range(4):
+        m = build_stamp("cifar10", "plus", agent_idx=a).mask
+        slices.append(m)
+        union |= m
+    assert (union == full).all()
+    # vertical split is disjoint; horizontal halves overlap at cols 5..6
+    assert not (slices[0] & slices[1]).any()
+    assert (slices[2] & slices[3]).sum() == 2
+    # agent_idx wraps mod 4
+    m4 = build_stamp("cifar10", "plus", agent_idx=4).mask
+    assert (m4 == slices[0]).all()
+
+
+def test_cifar_dba_exact_coords():
+    s0 = build_stamp("cifar10", "plus", agent_idx=0).mask   # rows 5..8 col 5
+    assert _coords(s0) == {(i, 5) for i in range(5, 9)}
+    s1 = build_stamp("cifar10", "plus", agent_idx=1).mask   # rows 9..11
+    assert _coords(s1) == {(i, 5) for i in range(9, 12)}
+    s2 = build_stamp("cifar10", "plus", agent_idx=2).mask   # row 8 cols 2..6
+    assert _coords(s2) == {(8, j) for j in range(2, 7)}
+    s3 = build_stamp("cifar10", "plus", agent_idx=3).mask   # row 8 cols 5..8
+    assert _coords(s3) == {(8, j) for j in range(5, 9)}
+
+
+def test_fmnist_watermark_uint8_wraparound():
+    # x + trojan wraps mod 256 (utils.py:236, SURVEY.md 2.3.10)
+    s = build_stamp("fmnist", "copyright", data_dir="/nonexistent")
+    x = np.full((28, 28, 1), 200, np.uint8)
+    out = np.asarray(apply_stamp(x, s))
+    assert out.dtype == np.uint8
+    hot = s.value >= 56  # 200 + v >= 256 wraps
+    if hot.any():
+        i, j = np.argwhere(hot)[0]
+        assert out[i, j, 0] == (200 + int(s.value[i, j])) % 256
