@@ -64,6 +64,7 @@ class Config:
     eval_bs: int = 1024
     profile_dir: str = ""           # "" disables jax.profiler traces
     use_pallas: bool = False        # fused RLR+aggregate TPU kernel
+    diagnostics: bool = False       # Norms/* + Sign/* research scalars (C13)
     tensorboard: bool = True        # JSONL metrics always; TB optional
     # synthetic-data knobs (used when `data` is missing on disk or 'synthetic')
     synth_train_size: int = 2048
@@ -170,6 +171,9 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--eval_bs", type=int, default=d.eval_bs)
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
     p.add_argument("--use_pallas", action="store_true")
+    p.add_argument("--diagnostics", action="store_true",
+                   help="log Norms/* and Sign/* research scalars "
+                        "(the reference's dead-code diagnostics, C13)")
     p.add_argument("--no_tensorboard", action="store_true")
     p.add_argument("--synth_train_size", type=int, default=d.synth_train_size)
     p.add_argument("--synth_val_size", type=int, default=d.synth_val_size)

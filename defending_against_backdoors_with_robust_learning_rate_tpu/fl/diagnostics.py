@@ -1,0 +1,139 @@
+"""Research diagnostics — the reference's C13 subsystem re-built jit-first.
+
+Reference: aggregation.py:77-191 (commented out of the round loop at
+aggregation.py:43-44; controlled by --top_frac). Components:
+
+- `clip_updates`  (aggregation.py:77-81): server-side L2 clip of each agent
+  update to `clip` — never called in the reference; provided for completeness.
+- update-norm logging (`plot_norms`, aggregation.py:83-100): average L2 of
+  honest vs corrupt updates, scalars `Norms/Avg_Honest_L2` /
+  `Norms/Avg_Corrupt_L2`.
+- `fisher_diag` (`comp_diag_fisher`, aggregation.py:102-129): diagonal Fisher
+  information over the poisoned val set. Quirk preserved: despite computing
+  log_softmax, the reference differentiates the *raw target logits*
+  (aggregation.py:121-124 gathers from `outputs`, not `log_all_probs`); we do
+  the same. `adv=False` relabels everything to `base_class`
+  (aggregation.py:117-118). Per-batch squared grads are accumulated divided
+  by the dataset size.
+- `sign_agreement` (`plot_sign_agreement`, aggregation.py:132-191): ranks
+  parameters by adversarial vs honest Fisher mass, intersects the top
+  `top_frac` with the RLR-maximized/minimized coordinate sets, and logs seven
+  `Sign/*` L2 scalars plus the cumulative net movement.
+
+The Fisher pass is a jitted `lax.scan` (no Python batch loop); the set
+algebra runs host-side at `snap` cadence on flat vectors (ravel_pytree at
+this analysis boundary only).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+
+
+def clip_updates(stacked_updates, clip: float):
+    """Server-side per-agent L2 clip (aggregation.py:77-81):
+    u <- u / max(1, ||u||/clip), per agent row."""
+    def leaf_sq(u):
+        return jnp.sum(jnp.square(u.reshape(u.shape[0], -1)), axis=1)
+    sq = sum(leaf_sq(u) for u in jax.tree_util.tree_leaves(stacked_updates))
+    denom = jnp.maximum(1.0, jnp.sqrt(sq) / clip)          # [m]
+
+    def leaf(u):
+        shape = (-1,) + (1,) * (u.ndim - 1)
+        return u / denom.reshape(shape)
+    return tree.map(leaf, stacked_updates)
+
+
+def per_agent_norms(stacked_updates):
+    """[m] L2 norms of the stacked agent updates (plot_norms input)."""
+    def leaf_sq(u):
+        return jnp.sum(jnp.square(u.reshape(u.shape[0], -1)), axis=1)
+    sq = sum(leaf_sq(u) for u in jax.tree_util.tree_leaves(stacked_updates))
+    return jnp.sqrt(sq)
+
+
+def norm_scalars(norms, sampled_ids, num_corrupt: int) -> Dict[str, float]:
+    """Average honest/corrupt update norms (aggregation.py:83-100); the
+    corrupt set is `sampled id < num_corrupt` (agent.py:19)."""
+    norms = np.asarray(norms)
+    corrupt = np.asarray(sampled_ids) < num_corrupt
+    out = {}
+    if (~corrupt).any():
+        out["Norms/Avg_Honest_L2"] = float(norms[~corrupt].mean())
+    if corrupt.any():
+        out["Norms/Avg_Corrupt_L2"] = float(norms[corrupt].mean())
+    return out
+
+
+def make_fisher_fn(model, normalize):
+    """fisher(params, images[nb,bs,...], labels[nb,bs], w[nb,bs]) -> pytree of
+    diagonal Fisher estimates (aggregation.py:102-129 semantics)."""
+
+    @jax.jit
+    def fisher(params, images, labels, weights):
+        n = jnp.sum(weights)
+
+        def batch_grad_sq(carry, batch):
+            x, y, w = batch
+
+            def target_logit_sum(p):
+                logits = model.apply({"params": p}, normalize(x), train=False)
+                picked = jnp.take_along_axis(logits, y[:, None], axis=1)[:, 0]
+                return jnp.sum(picked * w)
+
+            g = jax.grad(target_logit_sum)(params)
+            carry = tree.map(lambda c, gi: c + jnp.square(gi) / n, carry, g)
+            return carry, None
+
+        init = tree.zeros_like(params)
+        out, _ = jax.lax.scan(batch_grad_sq, init, (images, labels, weights))
+        return out
+
+    return fisher
+
+
+def sign_agreement(lr_flat: np.ndarray, update_flat: np.ndarray,
+                   fisher_adv_flat: np.ndarray, fisher_hon_flat: np.ndarray,
+                   top_frac: int, server_lr: float,
+                   cum_net_mov: float) -> Tuple[Dict[str, float], float]:
+    """The Sign/* scalar family (aggregation.py:132-191). Returns
+    (scalars, new_cum_net_mov)."""
+    n_idxs = top_frac
+    adv_top = np.argsort(fisher_adv_flat)[-n_idxs:]
+    hon_top = np.argsort(fisher_hon_flat)[-n_idxs:]
+    min_idxs = np.nonzero(lr_flat == -server_lr)[0]
+    max_idxs = np.nonzero(lr_flat == server_lr)[0]
+
+    max_adv = np.intersect1d(adv_top, max_idxs)
+    max_hon = np.intersect1d(hon_top, max_idxs)
+    min_adv = np.intersect1d(adv_top, min_idxs)
+    min_hon = np.intersect1d(hon_top, min_idxs)
+
+    def l2(idxs_a, idxs_b):
+        only = np.setdiff1d(idxs_a, idxs_b)
+        return float(np.linalg.norm(update_flat[only]))
+
+    max_adv_l2 = l2(max_adv, max_hon)
+    max_hon_l2 = l2(max_hon, max_adv)
+    min_adv_l2 = l2(min_adv, min_hon)
+    min_hon_l2 = l2(min_hon, min_adv)
+
+    net_adv = max_adv_l2 - min_adv_l2
+    net_hon = max_hon_l2 - min_hon_l2
+    cum_net_mov += net_hon - net_adv
+    scalars = {
+        "Sign/Hon_Maxim_L2": max_hon_l2,
+        "Sign/Adv_Maxim_L2": max_adv_l2,
+        "Sign/Adv_Minim_L2": min_adv_l2,
+        "Sign/Hon_Minim_L2": min_hon_l2,
+        "Sign/Adv_Net_L2": net_adv,
+        "Sign/Hon_Net_L2": net_hon,
+        "Sign/Model_Net_L2_Cumulative": cum_net_mov,
+    }
+    return scalars, cum_net_mov

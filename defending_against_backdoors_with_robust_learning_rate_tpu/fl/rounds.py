@@ -31,6 +31,14 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate imp
     aggregate_updates, apply_aggregate, robust_lr)
 
 
+def _pallas_applicable(cfg) -> bool:
+    """The fused Pallas server step covers the (weighted-FedAvg [+ RLR],
+    no server noise) path — the paper's headline configuration. Diagnostics
+    need the explicit lr tree, which the fused kernel never materializes."""
+    return (bool(cfg.use_pallas) and cfg.aggr == "avg" and cfg.noise == 0
+            and not cfg.diagnostics)
+
+
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 local_train, cfg):
     """Shared round body: vmapped local training + aggregation + update."""
@@ -38,6 +46,14 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     agent_keys = jax.random.split(k_train, m)
     updates, losses = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
         params, imgs, lbls, sizes, agent_keys)
+    if _pallas_applicable(cfg):
+        from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
+            fused_rlr_avg_apply)
+        new_params = fused_rlr_avg_apply(
+            params, updates, sizes.astype(jnp.float32),
+            float(cfg.robustLR_threshold), cfg.effective_server_lr,
+            interpret=jax.default_backend() != "tpu")
+        return new_params, jnp.mean(losses), {}
     if cfg.robustLR_threshold > 0:
         lr = robust_lr(updates, float(cfg.robustLR_threshold),
                        cfg.effective_server_lr)
@@ -45,7 +61,15 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         lr = cfg.effective_server_lr
     agg = aggregate_updates(updates, sizes, cfg, k_noise)
     new_params = apply_aggregate(params, lr, agg)
-    return new_params, jnp.mean(losses)
+    extras = {}
+    if cfg.diagnostics:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
+            per_agent_norms)
+        from jax.flatten_util import ravel_pytree
+        extras["agent_norms"] = per_agent_norms(updates)
+        if cfg.robustLR_threshold > 0:
+            extras["lr_flat"] = ravel_pytree(lr)[0]
+    return new_params, jnp.mean(losses), extras
 
 
 def make_round_fn(cfg, model, normalize, images, labels, sizes):
@@ -65,10 +89,11 @@ def make_round_fn(cfg, model, normalize, images, labels, sizes):
         imgs = jnp.take(images, sampled, axis=0)
         lbls = jnp.take(labels, sampled, axis=0)
         szs = jnp.take(sizes, sampled, axis=0)
-        new_params, train_loss = _round_core(
+        new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
             local_train=local_train, cfg=cfg)
-        return new_params, {"train_loss": train_loss, "sampled": sampled}
+        return new_params, {"train_loss": train_loss, "sampled": sampled,
+                            **extras}
 
     return round_fn
 
@@ -83,9 +108,9 @@ def make_round_fn_host(cfg, model, normalize):
     @jax.jit
     def round_fn(params, key, imgs, lbls, sizes):
         k_train, k_noise = jax.random.split(key)
-        new_params, train_loss = _round_core(
+        new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, sizes,
             local_train=local_train, cfg=cfg)
-        return new_params, {"train_loss": train_loss}
+        return new_params, {"train_loss": train_loss, **extras}
 
     return round_fn

@@ -1,0 +1,101 @@
+"""Fused RLR + FedAvg + apply — a Pallas TPU kernel for the server hot op.
+
+The defended-FedAvg server step (the paper's headline path) is, per parameter
+coordinate j over the m sampled agents' updates U (reference:
+src/aggregation.py:19-54 computes these as three separate passes over the
+update set):
+
+    vote_j = | sum_i sign(U_ij) |                 (RLR sign-agreement vote)
+    lr_j   = +server_lr if vote_j >= threshold else -server_lr
+    avg_j  = sum_i w_i U_ij          (weights pre-normalized to sum to 1)
+    p'_j   = p_j + lr_j * avg_j
+
+Unfused, XLA materializes the sign tree, the vote tree, the lr tree and the
+aggregate tree — each a full n-parameter array read/written to HBM. The
+Pallas kernel makes one pass: each grid step DMAs a [m, BLOCK] tile of U into
+VMEM, computes vote/lr/avg on the VPU, and writes only the updated parameter
+tile. U is read exactly once from HBM; nothing else round-trips.
+
+The kernel operates on the flat [m, n] update matrix (ravel_pytree at the
+call boundary); rows are padded to the f32 sublane multiple with zeros, which
+are exact no-ops (sign(0)=0 contributes nothing to the vote, weight 0 to the
+average). Columns are padded to the lane multiple.
+
+CPU/tests run the same kernel with interpret=True; `use_pallas=False`
+(default) keeps the pure-jnp path (ops/aggregate.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree as tree_ops
+
+_BLOCK = 1024          # lane-dim tile (multiple of 128)
+_SUBLANE = 8           # f32 sublane multiple
+
+
+def _kernel(u_ref, wn_ref, p_ref, o_ref, *, threshold, server_lr, use_rlr):
+    u = u_ref[:]                                   # [m_pad, BLOCK]
+    wavg = jnp.sum(u * wn_ref[:], axis=0)          # weighted FedAvg
+    if use_rlr:
+        vote = jnp.abs(jnp.sum(jnp.sign(u), axis=0))
+        lr = jnp.where(vote >= threshold, server_lr, -server_lr)
+    else:
+        lr = server_lr
+    o_ref[:] = p_ref[:] + (lr * wavg)[None, :]
+
+
+def fused_rlr_avg_apply_flat(params_flat, updates_flat, weights,
+                             threshold: float, server_lr: float,
+                             interpret: bool = False):
+    """params': [n]; updates: [m, n]; weights: [m] (need not be normalized).
+    threshold <= 0 disables the RLR vote (plain server_lr FedAvg)."""
+    m, n = updates_flat.shape
+    m_pad = -(-m // _SUBLANE) * _SUBLANE
+    n_pad = -(-n // _BLOCK) * _BLOCK
+
+    u = jnp.zeros((m_pad, n_pad), jnp.float32)
+    u = u.at[:m, :n].set(updates_flat.astype(jnp.float32))
+    wn = jnp.zeros((m_pad, 1), jnp.float32)
+    wn = wn.at[:m, 0].set(weights.astype(jnp.float32) /
+                          jnp.sum(weights.astype(jnp.float32)))
+    p = jnp.zeros((1, n_pad), jnp.float32)
+    p = p.at[0, :n].set(params_flat.astype(jnp.float32))
+
+    kernel = functools.partial(_kernel, threshold=float(threshold),
+                               server_lr=float(server_lr),
+                               use_rlr=threshold > 0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLOCK,),
+        in_specs=[
+            pl.BlockSpec((m_pad, _BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((m_pad, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=interpret,
+    )(u, wn, p)
+    return out[0, :n]
+
+
+def fused_rlr_avg_apply(params, stacked_updates, weights,
+                        threshold: float, server_lr: float,
+                        interpret: bool = False):
+    """Pytree wrapper: ravel -> fused kernel -> unravel."""
+    from jax.flatten_util import ravel_pytree
+
+    flat_p, unravel = ravel_pytree(params)
+    m = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
+    flat_u = jax.vmap(lambda i: ravel_pytree(
+        tree_ops.map(lambda x: x[i], stacked_updates))[0])(jnp.arange(m))
+    new_flat = fused_rlr_avg_apply_flat(flat_p, flat_u, weights,
+                                        threshold, server_lr,
+                                        interpret=interpret)
+    return unravel(new_flat)

@@ -108,13 +108,28 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
         agg = _sharded_aggregate(updates, szs, cfg, noise_key)
         new_params = apply_aggregate(params, lr, agg)
         loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
-        return new_params, loss
+        extras = {}
+        if cfg.diagnostics:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
+                per_agent_norms)
+            from jax.flatten_util import ravel_pytree
+            extras["agent_norms"] = jax.lax.all_gather(
+                per_agent_norms(updates), AGENTS_AXIS, axis=0, tiled=True)
+            if cfg.robustLR_threshold > 0:
+                extras["lr_flat"] = ravel_pytree(lr)[0]
+        return new_params, loss, extras
+
+    extras_specs = {}
+    if cfg.diagnostics:
+        extras_specs["agent_norms"] = P()
+        if cfg.robustLR_threshold > 0:
+            extras_specs["lr_flat"] = P()
 
     sharded = jax.shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
                   P(AGENTS_AXIS), P()),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), extras_specs),
         check_vma=False)
 
     @jax.jit
@@ -125,8 +140,9 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
         lbls = jnp.take(labels, sampled, axis=0)
         szs = jnp.take(sizes, sampled, axis=0)
         agent_keys = jax.random.split(k_train, m)
-        new_params, train_loss = sharded(params, imgs, lbls, szs,
-                                         agent_keys, k_noise)
-        return new_params, {"train_loss": train_loss, "sampled": sampled}
+        new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
+                                                 agent_keys, k_noise)
+        return new_params, {"train_loss": train_loss, "sampled": sampled,
+                            **extras}
 
     return round_fn

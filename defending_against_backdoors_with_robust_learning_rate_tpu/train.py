@@ -52,9 +52,6 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     params = init_params(model, fed.train.images.shape[2:],
                          jax.random.PRNGKey(cfg.seed))
     print(f"[model] {type(model).__name__}: {param_count(params):,} params")
-    if cfg.use_pallas:
-        print("[pallas] fused RLR+aggregate kernel not wired into the round "
-              "path yet in this version; --use_pallas ignored")
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
 
     host_mode = fed.train.images.nbytes > DEVICE_RESIDENT_BYTES
@@ -65,41 +62,77 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
             make_sharded_round_fn)
         n_mesh = pick_agent_mesh_size(cfg.mesh, cfg.agents_per_round)
+
+    # diagnostics extras (lr vector, agent norms) are only consumed on snap
+    # rounds; off-snap rounds run a variant compiled without them
+    plain_cfg = cfg.replace(diagnostics=False)
+    host_sampler = None
     if n_mesh > 1:
         mesh = make_mesh(n_mesh)
         print(f"[mesh] {n_mesh} devices on the `agents` axis "
               f"({cfg.agents_per_round // n_mesh} agents/device)")
-        round_fn = make_sharded_round_fn(
-            cfg, model, norm, mesh, jnp.asarray(fed.train.images),
-            jnp.asarray(fed.train.labels), jnp.asarray(fed.train.sizes))
-        host_sampler = None
+        arrays = (jnp.asarray(fed.train.images),
+                  jnp.asarray(fed.train.labels),
+                  jnp.asarray(fed.train.sizes))
+        round_fn = make_sharded_round_fn(plain_cfg, model, norm, mesh, *arrays)
+        diag_round_fn = (make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
+                         if cfg.diagnostics else round_fn)
     elif host_mode:
         print(f"[data] host-sampled mode "
               f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
         if cfg.mesh != 1:
             print("[mesh] host-sampled mode is single-device in this "
                   "version; --mesh request ignored")
-        round_fn_host = make_round_fn_host(cfg, model, norm)
+        round_fn_host = make_round_fn_host(plain_cfg, model, norm)
+        diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
+                              if cfg.diagnostics else round_fn_host)
 
-        def host_sampler(params, key, rnd):
+        def host_sampler(params, key, rnd, want_diag):
             # per-round generator so --resume continues the same sampling
             # sequence the uninterrupted run would have used
             rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
             ids = rng.choice(cfg.num_agents, cfg.agents_per_round,
                              replace=False)
-            return round_fn_host(
+            fn = diag_round_fn_host if want_diag else round_fn_host
+            new_params, info = fn(
                 params, key,
                 jnp.asarray(fed.train.images[ids]),
                 jnp.asarray(fed.train.labels[ids]),
                 jnp.asarray(fed.train.sizes[ids]))
+            info["sampled"] = ids
+            return new_params, info
     else:
-        round_fn = make_round_fn(cfg, model, norm,
-                                 jnp.asarray(fed.train.images),
-                                 jnp.asarray(fed.train.labels),
-                                 jnp.asarray(fed.train.sizes))
-        host_sampler = None
+        arrays = (jnp.asarray(fed.train.images),
+                  jnp.asarray(fed.train.labels),
+                  jnp.asarray(fed.train.sizes))
+        round_fn = make_round_fn(plain_cfg, model, norm, *arrays)
+        diag_round_fn = (make_round_fn(cfg, model, norm, *arrays)
+                         if cfg.diagnostics else round_fn)
+
+    if cfg.use_pallas:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+            _pallas_applicable)
+        if n_mesh > 1:
+            print("[pallas] the sharded mesh path aggregates with XLA "
+                  "collectives; the fused kernel applies to the "
+                  "single-device path only — --use_pallas ignored")
+        elif _pallas_applicable(plain_cfg):
+            msg = "[pallas] fused RLR+FedAvg+apply server kernel enabled"
+            if cfg.diagnostics:
+                msg += (" (snap rounds use the jnp path: diagnostics need "
+                        "the explicit lr vector)")
+            print(msg)
+        else:
+            print(f"[pallas] fused kernel covers aggr=avg with noise=0; "
+                  f"aggr={cfg.aggr!r} noise={cfg.noise} falls back to the "
+                  f"jnp path")
 
     eval_fn = make_eval_fn(model, norm, cfg.n_classes)
+    fisher_fn = None
+    if cfg.diagnostics:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
+            make_fisher_fn, norm_scalars, sign_agreement)
+        fisher_fn = make_fisher_fn(model, norm)
     val = tuple(map(jnp.asarray, pad_eval_set(
         fed.val_images, fed.val_labels, cfg.eval_bs)))
     pval = tuple(map(jnp.asarray, pad_eval_set(
@@ -109,11 +142,12 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         writer = MetricsWriter(cfg.log_dir, run_name(cfg), cfg.tensorboard)
 
     base_key = jax.random.PRNGKey(cfg.seed)
-    start_round, cum_poison_acc = 0, 0.0
+    start_round, cum_poison_acc, cum_net_mov = 0, 0.0, 0.0
     if cfg.resume and cfg.checkpoint_dir:
         restored = ckpt.restore(cfg.checkpoint_dir, params)
         if restored is not None:
-            start_round, params, base_key, cum_poison_acc = restored
+            start_round, params, base_key, cum_poison_acc, cum_net_mov = \
+                restored
             params = jax.device_put(params)
             print(f"[ckpt] resumed from round {start_round}")
 
@@ -125,11 +159,37 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     rounds_done = 0
     for rnd in range(start_round + 1, cfg.rounds + 1):
         key = jax.random.fold_in(base_key, rnd)
+        snap_round = rnd % cfg.snap == 0
+        want_diag = cfg.diagnostics and snap_round
+        prev_params = params if want_diag else None
         if host_sampler is not None:
-            params, info = host_sampler(params, key, rnd)
+            params, info = host_sampler(params, key, rnd, want_diag)
         else:
-            params, info = round_fn(params, key)
+            params, info = (diag_round_fn if want_diag else round_fn)(
+                params, key)
         rounds_done += 1
+
+        if want_diag:
+            if "agent_norms" in info:
+                for tag, v in norm_scalars(info["agent_norms"],
+                                           info["sampled"],
+                                           cfg.num_corrupt).items():
+                    writer.scalar(tag, v, rnd)
+            if "lr_flat" in info:
+                from jax.flatten_util import ravel_pytree
+                # Fisher at the pre-update params (aggregation.py:146-148)
+                f_adv = ravel_pytree(fisher_fn(prev_params, *pval))[0]
+                hon_labels = jnp.full_like(pval[1], cfg.base_class)
+                f_hon = ravel_pytree(
+                    fisher_fn(prev_params, pval[0], hon_labels, pval[2]))[0]
+                upd_flat = (ravel_pytree(params)[0]
+                            - ravel_pytree(prev_params)[0])
+                scalars, cum_net_mov = sign_agreement(
+                    np.asarray(info["lr_flat"]), np.asarray(upd_flat),
+                    np.asarray(f_adv), np.asarray(f_hon),
+                    cfg.top_frac, cfg.effective_server_lr, cum_net_mov)
+                for tag, v in scalars.items():
+                    writer.scalar(tag, v, rnd)
 
         if rnd % cfg.snap == 0:
             val_loss, val_acc, per_class = eval_fn(params, *val)
@@ -159,7 +219,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                        "rounds_per_sec": rounds_done / elapsed}
             if cfg.checkpoint_dir:
                 ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
-                          cum_poison_acc)
+                          cum_poison_acc, cum_net_mov)
         writer.flush()
 
     if cfg.profile_dir:

@@ -20,13 +20,15 @@ def _ckptr():
     return ocp.StandardCheckpointer()
 
 
-def save(ckpt_dir: str, rnd: int, params, key, cum_poison_acc: float) -> None:
+def save(ckpt_dir: str, rnd: int, params, key, cum_poison_acc: float,
+         cum_net_mov: float = 0.0) -> None:
     path = os.path.join(os.path.abspath(ckpt_dir), f"round_{rnd:06d}")
     state = {
         "params": jax.device_get(params),
         "round": np.asarray(rnd, np.int64),
         "key": np.asarray(jax.device_get(jax.random.key_data(key))),
         "cum_poison_acc": np.asarray(cum_poison_acc, np.float64),
+        "cum_net_mov": np.asarray(cum_net_mov, np.float64),
     }
     ckptr = _ckptr()
     ckptr.save(path, state, force=True)
@@ -43,8 +45,9 @@ def latest_round(ckpt_dir: str) -> Optional[int]:
     return max(rounds) if rounds else None
 
 
-def restore(ckpt_dir: str, params_like) -> Optional[Tuple[int, Any, Any, float]]:
-    """Returns (round, params, key, cum_poison_acc) or None."""
+def restore(ckpt_dir: str, params_like
+            ) -> Optional[Tuple[int, Any, Any, float, float]]:
+    """Returns (round, params, key, cum_poison_acc, cum_net_mov) or None."""
     rnd = latest_round(ckpt_dir)
     if rnd is None:
         return None
@@ -55,7 +58,9 @@ def restore(ckpt_dir: str, params_like) -> Optional[Tuple[int, Any, Any, float]]
         "round": np.asarray(0, np.int64),
         "key": np.zeros(key_shape, np.uint32),
         "cum_poison_acc": np.asarray(0.0, np.float64),
+        "cum_net_mov": np.asarray(0.0, np.float64),
     }
     state = _ckptr().restore(path, target)
     key = jax.random.wrap_key_data(state["key"])
-    return int(state["round"]), state["params"], key, float(state["cum_poison_acc"])
+    return (int(state["round"]), state["params"], key,
+            float(state["cum_poison_acc"]), float(state["cum_net_mov"]))

@@ -1,0 +1,36 @@
+"""Orbax checkpoint/resume roundtrip (the subsystem the reference lacks,
+SURVEY.md section 5.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+    checkpoint as ckpt)
+
+
+def test_roundtrip(tmp_path):
+    d = str(tmp_path / "ck")
+    params = {"a": jnp.arange(6.0).reshape(2, 3),
+              "b": {"k": jnp.asarray([1.5, -2.5])}}
+    key = jax.random.PRNGKey(123)
+    ckpt.save(d, 7, params, key, 3.25, cum_net_mov=-1.5)
+    ckpt.save(d, 9, params, key, 4.5, cum_net_mov=2.0)
+
+    like = jax.tree_util.tree_map(jnp.zeros_like, params)
+    rnd, p, k, cpa, cnm = ckpt.restore(d, like)
+    assert rnd == 9 and cpa == 4.5 and cnm == 2.0
+    np.testing.assert_array_equal(np.asarray(p["a"]), np.asarray(params["a"]))
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(k)),
+                                  np.asarray(jax.random.key_data(key)))
+
+
+def test_restore_empty_dir_returns_none(tmp_path):
+    assert ckpt.restore(str(tmp_path / "nope"), {}) is None
+
+
+def test_latest_round_ignores_orbax_tmp_dirs(tmp_path):
+    d = tmp_path / "ck"
+    (d / "round_000005").mkdir(parents=True)
+    (d / "round_000007.orbax-checkpoint-tmp-12345").mkdir()
+    assert ckpt.latest_round(str(d)) == 5

@@ -1,0 +1,97 @@
+"""C13 diagnostics subsystem (reference aggregation.py:77-191)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
+    clip_updates, make_fisher_fn, norm_scalars, per_agent_norms,
+    sign_agreement)
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+
+
+def test_clip_updates_bounds_each_agent():
+    rng = np.random.default_rng(0)
+    u = {"w": jnp.asarray(rng.normal(size=(3, 50)) * 10, jnp.float32)}
+    out = clip_updates(u, 1.0)
+    norms = np.linalg.norm(np.asarray(out["w"]), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+    # small updates untouched (denom = max(1, ...))
+    u2 = {"w": jnp.full((2, 4), 0.01)}
+    out2 = clip_updates(u2, 1.0)
+    np.testing.assert_allclose(np.asarray(out2["w"]), 0.01, rtol=1e-6)
+
+
+def test_per_agent_norms_and_split():
+    u = {"a": jnp.asarray([[3.0, 0.0], [0.0, 4.0], [0.0, 0.0]]),
+         "b": jnp.asarray([[4.0], [3.0], [1.0]])}
+    norms = np.asarray(per_agent_norms(u))
+    np.testing.assert_allclose(norms, [5.0, 5.0, 1.0], rtol=1e-6)
+    # sampled ids (5, 0, 2) with num_corrupt=2 -> agent id 0 is corrupt
+    s = norm_scalars(norms, np.array([5, 0, 2]), num_corrupt=2)
+    assert s["Norms/Avg_Corrupt_L2"] == 5.0
+    np.testing.assert_allclose(s["Norms/Avg_Honest_L2"], 3.0)
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, *, train=False):
+        return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+
+def test_fisher_matches_torch_reference():
+    """Diagonal Fisher parity with comp_diag_fisher semantics
+    (aggregation.py:102-129): per-batch grad of the summed target *logits*,
+    squared, accumulated / dataset size."""
+    n, shape = 8, (3, 1, 1)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(n,) + shape).astype(np.float32)
+    y = rng.integers(0, 4, size=n).astype(np.int32)
+
+    model = Tiny()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1,) + shape))["params"]
+    fisher_fn = make_fisher_fn(model, lambda v: v.astype(jnp.float32))
+    # two batches of 4
+    imgs = jnp.asarray(x).reshape(2, 4, *shape)
+    lbls = jnp.asarray(y).reshape(2, 4)
+    w = jnp.ones((2, 4), jnp.float32)
+    ours = fisher_fn(params, imgs, lbls, w)
+
+    tm = torch.nn.Linear(3, 4)
+    with torch.no_grad():
+        tm.weight.copy_(torch.tensor(np.asarray(params["Dense_0"]["kernel"]).T))
+        tm.bias.copy_(torch.tensor(np.asarray(params["Dense_0"]["bias"])))
+    fisher_w = torch.zeros_like(tm.weight)
+    fisher_b = torch.zeros_like(tm.bias)
+    for b in range(2):
+        tm.zero_grad()
+        out = tm(torch.tensor(x.reshape(n, -1)[b * 4:(b + 1) * 4]))
+        tgt = out.gather(1, torch.tensor(y[b * 4:(b + 1) * 4].astype(np.int64))
+                         .view(-1, 1)).sum()
+        tgt.backward()
+        fisher_w += tm.weight.grad ** 2 / n
+        fisher_b += tm.bias.grad ** 2 / n
+    np.testing.assert_allclose(np.asarray(ours["Dense_0"]["kernel"]),
+                               fisher_w.numpy().T, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ours["Dense_0"]["bias"]),
+                               fisher_b.numpy(), atol=1e-5)
+
+
+def test_sign_agreement_scalars():
+    n = 10
+    lr = np.array([1, 1, 1, -1, -1, 1, -1, 1, -1, -1], np.float32)
+    update = np.arange(n, dtype=np.float32)
+    f_adv = np.zeros(n); f_adv[[0, 3]] = 10         # top-2 adv: {0, 3}
+    f_hon = np.zeros(n); f_hon[[1, 4]] = 10         # top-2 hon: {1, 4}
+    scalars, cum = sign_agreement(lr, update, f_adv, f_hon,
+                                  top_frac=2, server_lr=1.0, cum_net_mov=0.0)
+    # max_adv_only = {0}, max_hon_only = {1}, min_adv_only = {3}, min_hon = {4}
+    assert scalars["Sign/Adv_Maxim_L2"] == 0.0       # |update[0]| = 0
+    assert scalars["Sign/Hon_Maxim_L2"] == 1.0
+    assert scalars["Sign/Adv_Minim_L2"] == 3.0
+    assert scalars["Sign/Hon_Minim_L2"] == 4.0
+    assert scalars["Sign/Adv_Net_L2"] == -3.0
+    assert scalars["Sign/Hon_Net_L2"] == -3.0
+    assert cum == 0.0
