@@ -37,7 +37,7 @@ def main():
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
         make_normalizer)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-        make_round_fn)
+        make_chained_round_fn)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         get_model, init_params)
 
@@ -51,22 +51,28 @@ def main():
     params = init_params(model, fed.train.images.shape[2:],
                          jax.random.PRNGKey(0))
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
-    round_fn = make_round_fn(cfg, model, norm,
-                             jnp.asarray(fed.train.images),
-                             jnp.asarray(fed.train.labels),
-                             jnp.asarray(fed.train.sizes))
+    # chained execution: blocks of rounds fused into one lax.scan dispatch
+    # (bit-identical to per-round dispatch; see fl/rounds.py)
+    chain = 10
+    chained = make_chained_round_fn(cfg, model, norm,
+                                    jnp.asarray(fed.train.images),
+                                    jnp.asarray(fed.train.labels),
+                                    jnp.asarray(fed.train.sizes))
 
-    key = jax.random.PRNGKey(0)
+    base_key = jax.random.PRNGKey(0)
     # warmup / compile
     t0 = time.perf_counter()
-    params, _ = round_fn(params, key)
+    params, _ = chained(params, base_key, jnp.arange(1, chain + 1))
     jax.block_until_ready(params)
-    log(f"[bench] compile+first round: {time.perf_counter() - t0:.1f}s")
+    log(f"[bench] compile+first {chain}-round block: "
+        f"{time.perf_counter() - t0:.1f}s")
 
-    n_rounds = 10
+    n_blocks = 3
+    n_rounds = n_blocks * chain
     t0 = time.perf_counter()
-    for r in range(n_rounds):
-        params, _ = round_fn(params, jax.random.fold_in(key, r))
+    for b in range(n_blocks):
+        ids = jnp.arange((b + 1) * chain + 1, (b + 2) * chain + 1)
+        params, _ = chained(params, base_key, ids)
     jax.block_until_ready(params)
     elapsed = time.perf_counter() - t0
     rounds_per_sec = n_rounds / elapsed

@@ -57,6 +57,9 @@ class Config:
     arch: str = "auto"              # auto | cnn | resnet9
     dtype: str = "f32"              # f32 | bf16 (compute dtype on the MXU)
     mesh: int = 1                   # devices on the `agents` mesh axis; 0 = all
+    chain: int = 1                  # rounds fused per dispatch via lax.scan
+                                    # (capped at `snap`; >1 kills per-round
+                                    # host dispatch overhead, bit-identical)
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -164,6 +167,9 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype", type=str, default=d.dtype, help="f32|bf16")
     p.add_argument("--mesh", type=int, default=d.mesh,
                    help="devices on the `agents` mesh axis (0=all local devices)")
+    p.add_argument("--chain", type=int, default=d.chain,
+                   help="rounds fused into one compiled lax.scan dispatch "
+                        "(capped at --snap so eval cadence is unchanged)")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--log_dir", type=str, default=d.log_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)

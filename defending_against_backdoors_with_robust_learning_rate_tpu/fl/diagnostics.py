@@ -39,10 +39,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 def clip_updates(stacked_updates, clip: float):
     """Server-side per-agent L2 clip (aggregation.py:77-81):
     u <- u / max(1, ||u||/clip), per agent row."""
-    def leaf_sq(u):
-        return jnp.sum(jnp.square(u.reshape(u.shape[0], -1)), axis=1)
-    sq = sum(leaf_sq(u) for u in jax.tree_util.tree_leaves(stacked_updates))
-    denom = jnp.maximum(1.0, jnp.sqrt(sq) / clip)          # [m]
+    denom = jnp.maximum(1.0, per_agent_norms(stacked_updates) / clip)  # [m]
 
     def leaf(u):
         shape = (-1,) + (1,) * (u.ndim - 1)

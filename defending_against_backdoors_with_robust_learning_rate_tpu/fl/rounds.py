@@ -98,6 +98,41 @@ def make_round_fn(cfg, model, normalize, images, labels, sizes):
     return round_fn
 
 
+def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
+    """Round-chained fn: chained(params, base_key, round_ids) -> (params, info).
+
+    Fuses a whole block of FL rounds into ONE compiled program via `lax.scan`
+    over the round ids — the per-round host dispatch of the reference loop
+    (src/federated.py:65) disappears entirely. Round r's key is
+    `fold_in(base_key, r)`, exactly the driver loop's derivation, so a chained
+    block is bit-identical to dispatching the same rounds one at a time.
+
+    info leaves are stacked per-round ([n_chain, ...]). Diagnostics extras are
+    not supported here (the driver runs diagnostic snap rounds unchained).
+    """
+    local_train = make_local_train(model, cfg, normalize)
+    K, m = cfg.num_agents, cfg.agents_per_round
+    cfg = cfg.replace(diagnostics=False)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def chained(params, base_key, round_ids):
+        def body(params, rnd):
+            key = jax.random.fold_in(base_key, rnd)
+            k_sample, k_train, k_noise = jax.random.split(key, 3)
+            sampled = jax.random.permutation(k_sample, K)[:m]
+            imgs = jnp.take(images, sampled, axis=0)
+            lbls = jnp.take(labels, sampled, axis=0)
+            szs = jnp.take(sizes, sampled, axis=0)
+            new_params, train_loss, _ = _round_core(
+                params, k_train, k_noise, imgs, lbls, szs,
+                local_train=local_train, cfg=cfg)
+            return new_params, {"train_loss": train_loss, "sampled": sampled}
+
+        return jax.lax.scan(body, params, round_ids)
+
+    return chained
+
+
 def make_round_fn_host(cfg, model, normalize):
     """Host-sampled round fn: round(params, key, imgs, lbls, sizes).
 

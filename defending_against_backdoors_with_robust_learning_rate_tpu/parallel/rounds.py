@@ -18,6 +18,8 @@ path is asserted in tests/test_parallel.py on a faked 8-device CPU mesh.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -85,16 +87,10 @@ def _sharded_robust_lr(updates, cfg):
     return tree.map(leaf, updates)
 
 
-def make_sharded_round_fn(cfg, model, normalize, mesh,
-                          images, labels, sizes):
-    """Device-resident sharded round fn: round(params, key) -> (params, info).
-
-    images/labels/sizes: full K-agent stacked arrays. The per-round gather of
-    the m sampled shards happens in-jit; the gathered [m, ...] arrays are
-    partitioned over the mesh by shard_map's in_specs.
-    """
+def _build_sharded_body(cfg, model, normalize, mesh):
+    """The shard_mapped round body shared by the per-round and chained fns."""
     local_train = make_local_train(model, cfg, normalize)
-    K, m = cfg.num_agents, cfg.agents_per_round
+    m = cfg.agents_per_round
     d = mesh.devices.size
     assert m % d == 0, f"agents_per_round={m} not divisible by mesh size {d}"
 
@@ -125,12 +121,24 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
         if cfg.robustLR_threshold > 0:
             extras_specs["lr_flat"] = P()
 
-    sharded = jax.shard_map(
+    return jax.shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
                   P(AGENTS_AXIS), P()),
         out_specs=(P(), P(), extras_specs),
         check_vma=False)
+
+
+def make_sharded_round_fn(cfg, model, normalize, mesh,
+                          images, labels, sizes):
+    """Device-resident sharded round fn: round(params, key) -> (params, info).
+
+    images/labels/sizes: full K-agent stacked arrays. The per-round gather of
+    the m sampled shards happens in-jit; the gathered [m, ...] arrays are
+    partitioned over the mesh by shard_map's in_specs.
+    """
+    sharded = _build_sharded_body(cfg, model, normalize, mesh)
+    K, m = cfg.num_agents, cfg.agents_per_round
 
     @jax.jit
     def round_fn(params, key):
@@ -146,3 +154,34 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
                             **extras}
 
     return round_fn
+
+
+def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
+                                  images, labels, sizes):
+    """Chained sharded rounds: chained(params, base_key, round_ids).
+
+    `lax.scan` over a block of rounds with the shard_mapped round body inside
+    — one XLA program per block, collectives included; key derivation
+    (`fold_in(base_key, r)`) matches the driver loop bit-for-bit (see
+    fl/rounds.make_chained_round_fn). Diagnostics extras unsupported."""
+    cfg = cfg.replace(diagnostics=False)
+    sharded = _build_sharded_body(cfg, model, normalize, mesh)
+    K, m = cfg.num_agents, cfg.agents_per_round
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def chained(params, base_key, round_ids):
+        def body(params, rnd):
+            key = jax.random.fold_in(base_key, rnd)
+            k_sample, k_train, k_noise = jax.random.split(key, 3)
+            sampled = jax.random.permutation(k_sample, K)[:m]
+            imgs = jnp.take(images, sampled, axis=0)
+            lbls = jnp.take(labels, sampled, axis=0)
+            szs = jnp.take(sizes, sampled, axis=0)
+            agent_keys = jax.random.split(k_train, m)
+            new_params, train_loss, _ = sharded(params, imgs, lbls, szs,
+                                                agent_keys, k_noise)
+            return new_params, {"train_loss": train_loss, "sampled": sampled}
+
+        return jax.lax.scan(body, params, round_ids)
+
+    return chained

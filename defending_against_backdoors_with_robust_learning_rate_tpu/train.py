@@ -67,6 +67,11 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     # rounds; off-snap rounds run a variant compiled without them
     plain_cfg = cfg.replace(diagnostics=False)
     host_sampler = None
+    chained_fn = None
+    # a diagnostic snap round always runs unchained, so it is excluded from
+    # the per-boundary chain budget
+    chain_n = max(1, min(cfg.chain,
+                         cfg.snap - (1 if cfg.diagnostics else 0)))
     if n_mesh > 1:
         mesh = make_mesh(n_mesh)
         print(f"[mesh] {n_mesh} devices on the `agents` axis "
@@ -77,12 +82,20 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         round_fn = make_sharded_round_fn(plain_cfg, model, norm, mesh, *arrays)
         diag_round_fn = (make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
                          if cfg.diagnostics else round_fn)
+        if chain_n > 1:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                make_sharded_chained_round_fn)
+            chained_fn = make_sharded_chained_round_fn(
+                plain_cfg, model, norm, mesh, *arrays)
     elif host_mode:
         print(f"[data] host-sampled mode "
               f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
         if cfg.mesh != 1:
             print("[mesh] host-sampled mode is single-device in this "
                   "version; --mesh request ignored")
+        if cfg.chain > 1:
+            print("[chain] host-sampled mode gathers shards per round; "
+                  "--chain request ignored")
         round_fn_host = make_round_fn_host(plain_cfg, model, norm)
         diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
                               if cfg.diagnostics else round_fn_host)
@@ -108,6 +121,12 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         round_fn = make_round_fn(plain_cfg, model, norm, *arrays)
         diag_round_fn = (make_round_fn(cfg, model, norm, *arrays)
                          if cfg.diagnostics else round_fn)
+        if chain_n > 1:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                make_chained_round_fn)
+            chained_fn = make_chained_round_fn(plain_cfg, model, norm, *arrays)
+    if chained_fn is not None:
+        print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan)")
 
     if cfg.use_pallas:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
@@ -157,17 +176,33 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     summary: Dict = {}
     t_loop = time.perf_counter()
     rounds_done = 0
-    for rnd in range(start_round + 1, cfg.rounds + 1):
-        key = jax.random.fold_in(base_key, rnd)
-        snap_round = rnd % cfg.snap == 0
-        want_diag = cfg.diagnostics and snap_round
-        prev_params = params if want_diag else None
-        if host_sampler is not None:
-            params, info = host_sampler(params, key, rnd, want_diag)
+    rnd = start_round
+    while rnd < cfg.rounds:
+        # rounds until the next eval boundary (or the end of the run)
+        to_eval = min(cfg.snap - rnd % cfg.snap, cfg.rounds - rnd)
+        # a diagnostic snap round must run unchained (it needs prev_params
+        # and the diag-compiled variant), so it is excluded from the budget
+        budget = to_eval - (1 if cfg.diagnostics else 0)
+        if chained_fn is not None and budget >= chain_n:
+            # fixed block length => one compilation serves every block
+            ids = jnp.arange(rnd + 1, rnd + chain_n + 1)
+            params, stacked = chained_fn(params, base_key, ids)
+            rnd += chain_n
+            rounds_done += chain_n
+            info = {"train_loss": stacked["train_loss"][-1]}
+            want_diag, prev_params = False, None
         else:
-            params, info = (diag_round_fn if want_diag else round_fn)(
-                params, key)
-        rounds_done += 1
+            rnd += 1
+            key = jax.random.fold_in(base_key, rnd)
+            snap_round = rnd % cfg.snap == 0
+            want_diag = cfg.diagnostics and snap_round
+            prev_params = params if want_diag else None
+            if host_sampler is not None:
+                params, info = host_sampler(params, key, rnd, want_diag)
+            else:
+                params, info = (diag_round_fn if want_diag else round_fn)(
+                    params, key)
+            rounds_done += 1
 
         if want_diag:
             if "agent_norms" in info:

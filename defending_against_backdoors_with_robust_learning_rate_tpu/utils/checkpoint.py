@@ -60,7 +60,13 @@ def restore(ckpt_dir: str, params_like
         "cum_poison_acc": np.asarray(0.0, np.float64),
         "cum_net_mov": np.asarray(0.0, np.float64),
     }
-    state = _ckptr().restore(path, target)
+    try:
+        state = _ckptr().restore(path, target)
+    except ValueError:
+        # checkpoint written before cum_net_mov existed: restore without it
+        del target["cum_net_mov"]
+        state = _ckptr().restore(path, target)
+        state["cum_net_mov"] = np.asarray(0.0, np.float64)
     key = jax.random.wrap_key_data(state["key"])
     return (int(state["round"]), state["params"], key,
             float(state["cum_poison_acc"]), float(state["cum_net_mov"]))

@@ -1,0 +1,91 @@
+"""Chained-round execution (lax.scan over rounds) must be bit-compatible
+with per-round dispatch: round r's key is fold_in(base_key, r) in both paths
+(fl/rounds.make_chained_round_fn, parallel/rounds.make_sharded_chained_round_fn)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+    get_federated_data)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+    make_normalizer)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+    make_chained_round_fn, make_round_fn)
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+    get_model, init_params)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+    make_mesh)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+    make_sharded_chained_round_fn, make_sharded_round_fn)
+
+
+def _setup(num_agents=4):
+    cfg = Config(data="synthetic", num_agents=num_agents, bs=16, local_ep=1,
+                 synth_train_size=128, synth_val_size=32, num_corrupt=1,
+                 poison_frac=1.0, robustLR_threshold=2, seed=3)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+    return cfg, model, params, norm, arrays
+
+
+def _assert_trees_close(a, b, **kw):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+def test_chained_matches_per_round_dispatch():
+    cfg, model, params, norm, arrays = _setup()
+    base_key = jax.random.PRNGKey(7)
+    n = 4
+
+    round_fn = make_round_fn(cfg, model, norm, *arrays)
+    p_seq = params
+    losses_seq = []
+    for r in range(1, n + 1):
+        p_seq, info = round_fn(p_seq, jax.random.fold_in(base_key, r))
+        losses_seq.append(float(info["train_loss"]))
+
+    chained = make_chained_round_fn(cfg, model, norm, *arrays)
+    p_chain, stacked = chained(params, base_key, jnp.arange(1, n + 1))
+
+    _assert_trees_close(p_seq, p_chain, atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(stacked["train_loss"]),
+                               np.array(losses_seq), rtol=1e-5)
+    assert stacked["sampled"].shape == (n, cfg.agents_per_round)
+
+
+def test_sharded_chained_matches_sharded_per_round():
+    cfg, model, params, norm, arrays = _setup(num_agents=8)
+    mesh = make_mesh(4)
+    base_key = jax.random.PRNGKey(5)
+    n = 3
+
+    round_fn = make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
+    p_seq = params
+    for r in range(1, n + 1):
+        p_seq, _ = round_fn(p_seq, jax.random.fold_in(base_key, r))
+
+    chained = make_sharded_chained_round_fn(cfg, model, norm, mesh, *arrays)
+    p_chain, stacked = chained(params, base_key, jnp.arange(1, n + 1))
+
+    _assert_trees_close(p_seq, p_chain, atol=1e-5, rtol=1e-5)
+    assert stacked["train_loss"].shape == (n,)
+
+
+def test_run_with_chain_matches_unchained(tmp_path):
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import run
+
+    base = Config(data="synthetic", num_agents=4, bs=16, local_ep=1,
+                  synth_train_size=128, synth_val_size=32, rounds=4, snap=2,
+                  seed=9, log_dir=str(tmp_path), tensorboard=False)
+    s1 = run(base)
+    s2 = run(base.replace(chain=2))
+    np.testing.assert_allclose(s1["val_acc"], s2["val_acc"], rtol=1e-5)
+    np.testing.assert_allclose(s1["val_loss"], s2["val_loss"], rtol=1e-4)
