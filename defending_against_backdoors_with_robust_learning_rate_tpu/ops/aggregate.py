@@ -73,15 +73,22 @@ def agg_sign(stacked_updates):
                     stacked_updates)
 
 
+def sq_dist_accum(dist, flat):
+    """dist [m, m] += pairwise squared L2 distances of the rows of flat
+    [m, c] (sq-norm expansion; callers clamp negatives after the last
+    accumulation)."""
+    flat = flat.astype(jnp.float32)
+    sq = jnp.sum(flat * flat, axis=1)
+    return dist + sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+
+
 def _pairwise_sq_dists(stacked_updates):
     """[m, m] matrix of squared L2 distances summed across all leaves."""
     leaves = jax.tree_util.tree_leaves(stacked_updates)
     m = leaves[0].shape[0]
     d = jnp.zeros((m, m), jnp.float32)
     for u in leaves:
-        flat = u.reshape(m, -1).astype(jnp.float32)
-        sq = jnp.sum(flat * flat, axis=1)
-        d = d + sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+        d = sq_dist_accum(d, u.reshape(m, -1))
     return jnp.maximum(d, 0.0)
 
 

@@ -7,8 +7,19 @@ section 5.8:
 
     agg_avg          -> psum of locally-weighted sums            (ICI)
     agg_sign / RLR   -> psum of per-coordinate sign sums         (ICI)
-    agg_comed        -> all_gather over `agents`, then median
-    agg_krum         -> all_gather, pairwise distances, argmin
+    agg_comed        -> all_to_all transpose to param-sharded layout,
+                        local median, all_gather of median chunks
+    agg_krum         -> all_to_all transpose, chunk-partial pairwise
+                        distances psummed to the full [m, m] matrix,
+                        winner's chunks re-assembled by all_gather
+
+comed/krum deliberately avoid `all_gather`ing the full [m, n_params]
+update matrix (SURVEY.md 7.3.1: ~1 GiB/device at 256 agents x 1M params).
+The `all_to_all` transpose repurposes the mesh axis from agents to
+parameter chunks: each device ends up holding ALL m agents for 1/d of the
+coordinates — memory AND interconnect traffic drop by the mesh factor d,
+and the median/distance arithmetic is d-way parallel instead of
+replicated.
 
 Every device trains its block of m/d sampled agents (local `vmap`), then the
 collective aggregation produces *replicated* new global params — one compiled
@@ -28,14 +39,34 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import 
     make_local_train)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
-    _pairwise_sq_dists, apply_aggregate, gaussian_noise_like)
+    apply_aggregate, gaussian_noise_like, sq_dist_accum)
 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
     AGENTS_AXIS)
 
 
-def _sharded_aggregate(updates, sizes, cfg, key):
+def _to_param_shards(u, d):
+    """[m/d, ...] local agent block -> ([m, c] all agents x local param chunk,
+    flat length L). The all_to_all transposes the mesh axis from agents to
+    parameter chunks; rows arrive in device order = global agent order."""
+    mb = u.shape[0]
+    flat = u.reshape(mb, -1)
+    L = flat.shape[1]
+    pad = -L % d
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return jax.lax.all_to_all(flat, AGENTS_AXIS, split_axis=1, concat_axis=0,
+                              tiled=True), L
+
+
+def _from_param_shard(chunk, L, leaf_shape):
+    """[c] local param chunk -> [...] full replicated leaf (all_gather)."""
+    full = jax.lax.all_gather(chunk, AGENTS_AXIS, axis=0, tiled=True)
+    return full[:L].reshape(leaf_shape)
+
+
+def _sharded_aggregate(updates, sizes, cfg, d, key):
     """Aggregation rules as collectives. `updates` leaves are the local block
-    [m/d, ...]; returns the replicated aggregate."""
+    [m/d, ...]; `d` is the mesh size; returns the replicated aggregate."""
     ax = AGENTS_AXIS
     if cfg.aggr == "avg":
         w = sizes.astype(jnp.float32)
@@ -54,18 +85,26 @@ def _sharded_aggregate(updates, sizes, cfg, key):
         m = cfg.agents_per_round
 
         def leaf(u):
-            allu = jax.lax.all_gather(u, ax, axis=0, tiled=True)  # [m, ...]
-            return jnp.sort(allu, axis=0)[(m - 1) // 2]
+            chunk, L = _to_param_shards(u, d)            # [m, c]
+            med = jnp.sort(chunk, axis=0)[(m - 1) // 2]  # torch lower median
+            return _from_param_shard(med, L, u.shape[1:])
         agg = tree.map(leaf, updates)
     elif cfg.aggr == "krum":
-        full = tree.map(
-            lambda u: jax.lax.all_gather(u, ax, axis=0, tiled=True), updates)
-        d = _pairwise_sq_dists(full)
-        m = d.shape[0]
+        m = cfg.agents_per_round
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        shards = [_to_param_shards(u, d) for u in leaves]
+        # chunk-partial pairwise squared distances; psum over the mesh axis
+        # (now indexing param chunks) completes the sum over coordinates
+        dist = jnp.zeros((m, m), jnp.float32)
+        for chunk, _ in shards:
+            dist = sq_dist_accum(dist, chunk)
+        dist = jnp.maximum(jax.lax.psum(dist, ax), 0.0)
         k = max(m - cfg.num_corrupt - 2, 1)
-        srt = jnp.sort(d, axis=1)
+        srt = jnp.sort(dist, axis=1)
         best = jnp.argmin(jnp.sum(srt[:, 1:k + 1], axis=1))
-        agg = tree.map(lambda u: u[best], full)
+        agg = jax.tree_util.tree_unflatten(treedef, [
+            _from_param_shard(chunk[best], L, u.shape[1:])
+            for (chunk, L), u in zip(shards, leaves)])
     else:
         raise ValueError(f"unknown aggr {cfg.aggr!r}")
     if cfg.noise > 0:
@@ -101,7 +140,7 @@ def _build_sharded_body(cfg, model, normalize, mesh):
             lr = _sharded_robust_lr(updates, cfg)
         else:
             lr = cfg.effective_server_lr
-        agg = _sharded_aggregate(updates, szs, cfg, noise_key)
+        agg = _sharded_aggregate(updates, szs, cfg, d, noise_key)
         new_params = apply_aggregate(params, lr, agg)
         loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
         extras = {}

@@ -66,6 +66,31 @@ def test_sharded_round_matches_vmap_round(aggr):
                                float(info2["train_loss"]), rtol=1e-4)
 
 
+def test_param_shard_transpose_roundtrip():
+    """all_to_all param-sharding (SURVEY.md 7.3.1) is a lossless transpose:
+    agents-sharded [m/d, ...] -> all-agents x param-chunk [m, c] -> back."""
+    from jax.sharding import PartitionSpec as P
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+        _from_param_shard, _to_param_shards)
+
+    d = 8
+    mesh = make_mesh(d)
+    m, shape = 16, (3, 5, 7)   # flat length 105, not divisible by 8
+    u = jnp.arange(m * 105, dtype=jnp.float32).reshape((m,) + shape)
+
+    def body(ub):                      # ub: [m/d, ...] local block
+        chunk, L = _to_param_shards(ub, d)
+        assert chunk.shape == (m, -(-105 // d))
+        med = jnp.sort(chunk, axis=0)[(m - 1) // 2]
+        return _from_param_shard(med, L, shape)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P("agents"), out_specs=P(),
+        check_vma=False))(u)
+    expect = jnp.sort(u, axis=0)[(m - 1) // 2]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expect))
+
+
 def test_sharded_multiround_trains():
     cfg, model, params, norm, arrays = _setup("avg", num_corrupt=0)
     mesh = make_mesh(4)
