@@ -72,18 +72,38 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     return new_params, jnp.mean(losses), extras
 
 
-def make_round_fn(cfg, model, normalize, images, labels, sizes):
-    """Device-resident round fn: round(params, key) -> (params, metrics).
+def make_chained(step):
+    """Wrap a step(params, key) closure into chained(params, base_key,
+    round_ids): a `lax.scan` over rounds, round r keyed by
+    `fold_in(base_key, r)` (the driver loop's exact derivation — chained
+    blocks are bit-identical to per-round dispatch). Shared by the
+    single-device and sharded paths; info is reduced to the scannable
+    train_loss/sampled leaves."""
+    @functools.partial(jax.jit, donate_argnums=0)
+    def chained(params, base_key, round_ids):
+        def body(params, rnd):
+            new_params, info = step(params, jax.random.fold_in(base_key, rnd))
+            return new_params, {"train_loss": info["train_loss"],
+                                "sampled": info["sampled"]}
 
-    images/labels/sizes are the full K-agent stacked arrays (jnp, on device).
-    """
+        return jax.lax.scan(body, params, round_ids)
+
+    return chained
+
+
+def _make_sample_step(cfg, model, normalize, images, labels, sizes):
+    """Shared sample-and-step closure: step(params, key) -> (params, info).
+
+    Samples the round's m agents, gathers their device-resident shards
+    in-jit, and runs the round core. The key-derivation order (sample, train,
+    noise) matches parallel/rounds.py so the sharded and single-device paths
+    are comparable round-for-round — and both the per-round and chained fns
+    wrap THIS closure, which is what makes chained execution bit-identical
+    to per-round dispatch."""
     local_train = make_local_train(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
 
-    @jax.jit
-    def round_fn(params, key):
-        # key-derivation order matches parallel/rounds.py so the sharded and
-        # single-device paths are comparable round-for-round
+    def step(params, key):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         sampled = jax.random.permutation(k_sample, K)[:m]
         imgs = jnp.take(images, sampled, axis=0)
@@ -95,7 +115,16 @@ def make_round_fn(cfg, model, normalize, images, labels, sizes):
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
-    return round_fn
+    return step
+
+
+def make_round_fn(cfg, model, normalize, images, labels, sizes):
+    """Device-resident round fn: round(params, key) -> (params, metrics).
+
+    images/labels/sizes are the full K-agent stacked arrays (jnp, on device).
+    """
+    return jax.jit(_make_sample_step(cfg, model, normalize,
+                                     images, labels, sizes))
 
 
 def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
@@ -110,27 +139,9 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
     info leaves are stacked per-round ([n_chain, ...]). Diagnostics extras are
     not supported here (the driver runs diagnostic snap rounds unchained).
     """
-    local_train = make_local_train(model, cfg, normalize)
-    K, m = cfg.num_agents, cfg.agents_per_round
-    cfg = cfg.replace(diagnostics=False)
-
-    @functools.partial(jax.jit, donate_argnums=0)
-    def chained(params, base_key, round_ids):
-        def body(params, rnd):
-            key = jax.random.fold_in(base_key, rnd)
-            k_sample, k_train, k_noise = jax.random.split(key, 3)
-            sampled = jax.random.permutation(k_sample, K)[:m]
-            imgs = jnp.take(images, sampled, axis=0)
-            lbls = jnp.take(labels, sampled, axis=0)
-            szs = jnp.take(sizes, sampled, axis=0)
-            new_params, train_loss, _ = _round_core(
-                params, k_train, k_noise, imgs, lbls, szs,
-                local_train=local_train, cfg=cfg)
-            return new_params, {"train_loss": train_loss, "sampled": sampled}
-
-        return jax.lax.scan(body, params, round_ids)
-
-    return chained
+    return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
+                                          model, normalize,
+                                          images, labels, sizes))
 
 
 def make_round_fn_host(cfg, model, normalize):

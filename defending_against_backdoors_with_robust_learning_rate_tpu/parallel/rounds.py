@@ -29,12 +29,12 @@ path is asserted in tests/test_parallel.py on a faked 8-device CPU mesh.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+    make_chained)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
     make_local_train)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
@@ -168,19 +168,17 @@ def _build_sharded_body(cfg, model, normalize, mesh):
         check_vma=False)
 
 
-def make_sharded_round_fn(cfg, model, normalize, mesh,
-                          images, labels, sizes):
-    """Device-resident sharded round fn: round(params, key) -> (params, info).
+def _make_sample_step(cfg, model, normalize, mesh, images, labels, sizes):
+    """Shared sharded sample-and-step closure: step(params, key).
 
-    images/labels/sizes: full K-agent stacked arrays. The per-round gather of
-    the m sampled shards happens in-jit; the gathered [m, ...] arrays are
-    partitioned over the mesh by shard_map's in_specs.
-    """
+    Samples the round's m agents, gathers their shards in-jit (partitioned
+    over the mesh by shard_map's in_specs), and runs the shard_mapped body.
+    Both the per-round and chained fns wrap THIS closure — chained execution
+    stays bit-identical to per-round dispatch."""
     sharded = _build_sharded_body(cfg, model, normalize, mesh)
     K, m = cfg.num_agents, cfg.agents_per_round
 
-    @jax.jit
-    def round_fn(params, key):
+    def step(params, key):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         sampled = jax.random.permutation(k_sample, K)[:m]
         imgs = jnp.take(images, sampled, axis=0)
@@ -192,7 +190,19 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
-    return round_fn
+    return step
+
+
+def make_sharded_round_fn(cfg, model, normalize, mesh,
+                          images, labels, sizes):
+    """Device-resident sharded round fn: round(params, key) -> (params, info).
+
+    images/labels/sizes: full K-agent stacked arrays. The per-round gather of
+    the m sampled shards happens in-jit; the gathered [m, ...] arrays are
+    partitioned over the mesh by shard_map's in_specs.
+    """
+    return jax.jit(_make_sample_step(cfg, model, normalize, mesh,
+                                     images, labels, sizes))
 
 
 def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
@@ -203,24 +213,6 @@ def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
     — one XLA program per block, collectives included; key derivation
     (`fold_in(base_key, r)`) matches the driver loop bit-for-bit (see
     fl/rounds.make_chained_round_fn). Diagnostics extras unsupported."""
-    cfg = cfg.replace(diagnostics=False)
-    sharded = _build_sharded_body(cfg, model, normalize, mesh)
-    K, m = cfg.num_agents, cfg.agents_per_round
-
-    @functools.partial(jax.jit, donate_argnums=0)
-    def chained(params, base_key, round_ids):
-        def body(params, rnd):
-            key = jax.random.fold_in(base_key, rnd)
-            k_sample, k_train, k_noise = jax.random.split(key, 3)
-            sampled = jax.random.permutation(k_sample, K)[:m]
-            imgs = jnp.take(images, sampled, axis=0)
-            lbls = jnp.take(labels, sampled, axis=0)
-            szs = jnp.take(sizes, sampled, axis=0)
-            agent_keys = jax.random.split(k_train, m)
-            new_params, train_loss, _ = sharded(params, imgs, lbls, szs,
-                                                agent_keys, k_noise)
-            return new_params, {"train_loss": train_loss, "sampled": sampled}
-
-        return jax.lax.scan(body, params, round_ids)
-
-    return chained
+    return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
+                                          model, normalize, mesh,
+                                          images, labels, sizes))

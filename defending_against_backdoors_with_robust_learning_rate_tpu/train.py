@@ -182,7 +182,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         to_eval = min(cfg.snap - rnd % cfg.snap, cfg.rounds - rnd)
         # a diagnostic snap round must run unchained (it needs prev_params
         # and the diag-compiled variant), so it is excluded from the budget
-        budget = to_eval - (1 if cfg.diagnostics else 0)
+        # — but only when the block actually ends on a snap round (the run
+        # may end mid-interval)
+        diag_at_boundary = cfg.diagnostics and (rnd + to_eval) % cfg.snap == 0
+        budget = to_eval - (1 if diag_at_boundary else 0)
         if chained_fn is not None and budget >= chain_n:
             # fixed block length => one compilation serves every block
             ids = jnp.arange(rnd + 1, rnd + chain_n + 1)

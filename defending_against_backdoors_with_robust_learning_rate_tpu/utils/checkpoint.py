@@ -62,8 +62,11 @@ def restore(ckpt_dir: str, params_like
     }
     try:
         state = _ckptr().restore(path, target)
-    except ValueError:
-        # checkpoint written before cum_net_mov existed: restore without it
+    except ValueError as e:
+        # checkpoint written before cum_net_mov existed: restore without it.
+        # Any other structural mismatch (e.g. params shape change) re-raises.
+        if "cum_net_mov" not in str(e):
+            raise
         del target["cum_net_mov"]
         state = _ckptr().restore(path, target)
         state["cum_net_mov"] = np.asarray(0.0, np.float64)

@@ -29,6 +29,42 @@ def test_restore_empty_dir_returns_none(tmp_path):
     assert ckpt.restore(str(tmp_path / "nope"), {}) is None
 
 
+def test_legacy_checkpoint_without_cum_net_mov_restores(tmp_path):
+    """Checkpoints written before cum_net_mov existed restore via the
+    fallback branch, defaulting cum_net_mov to 0."""
+    import os
+    import orbax.checkpoint as ocp
+
+    d = str(tmp_path / "ck")
+    params = {"a": jnp.arange(4.0)}
+    key = jax.random.PRNGKey(5)
+    legacy = {
+        "params": jax.device_get(params),
+        "round": np.asarray(3, np.int64),
+        "key": np.asarray(jax.device_get(jax.random.key_data(key))),
+        "cum_poison_acc": np.asarray(1.25, np.float64),
+    }
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(d, "round_000003"), legacy, force=True)
+    ckptr.wait_until_finished()
+
+    rnd, p, k, cpa, cnm = ckpt.restore(
+        d, jax.tree_util.tree_map(jnp.zeros_like, params))
+    assert rnd == 3 and cpa == 1.25 and cnm == 0.0
+    np.testing.assert_array_equal(np.asarray(p["a"]), np.asarray(params["a"]))
+
+
+def test_restore_structure_mismatch_reraises(tmp_path):
+    """A real structural mismatch (different param tree) is NOT swallowed by
+    the legacy-cum_net_mov fallback."""
+    import pytest
+
+    d = str(tmp_path / "ck")
+    ckpt.save(d, 1, {"a": jnp.arange(4.0)}, jax.random.PRNGKey(0), 0.0)
+    with pytest.raises(ValueError):
+        ckpt.restore(d, {"renamed": jnp.zeros(4)})
+
+
 def test_latest_round_ignores_orbax_tmp_dirs(tmp_path):
     d = tmp_path / "ck"
     (d / "round_000005").mkdir(parents=True)
