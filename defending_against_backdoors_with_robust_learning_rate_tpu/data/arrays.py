@@ -32,20 +32,26 @@ class AgentShards:
         return self.images.shape[1]
 
 
+def padded_max_n(sizes: np.ndarray, pad_multiple: int = 1) -> int:
+    """Shared padding rule: the stacked shard length is the max true shard
+    size rounded up to `pad_multiple` (e.g. the batch size) so downstream
+    reshapes into [n_batches, bs] are exact. The native runtime
+    (data/native.py) and the numpy paths below both use THIS function, so
+    the layouts can never diverge."""
+    max_n = int(sizes.max()) if len(sizes) else 0
+    if pad_multiple > 1:
+        max_n = ((max_n + pad_multiple - 1) // pad_multiple) * pad_multiple
+    return max_n
+
+
 def stack_agent_shards(images: np.ndarray, labels: np.ndarray,
                        user_groups: Dict[int, Sequence[int]],
                        num_agents: int,
                        pad_multiple: int = 1) -> AgentShards:
-    """Gather each agent's indices into a padded stacked array.
-
-    `pad_multiple` rounds max_n up (e.g. to the batch size) so downstream
-    reshapes into [n_batches, bs] are exact.
-    """
+    """Gather each agent's indices into a padded stacked array."""
     sizes = np.array([len(user_groups.get(a, ())) for a in range(num_agents)],
                      dtype=np.int32)
-    max_n = int(sizes.max()) if num_agents else 0
-    if pad_multiple > 1:
-        max_n = ((max_n + pad_multiple - 1) // pad_multiple) * pad_multiple
+    max_n = padded_max_n(sizes, pad_multiple)
     shp = images.shape[1:]
     out_img = np.zeros((num_agents, max_n) + shp, dtype=images.dtype)
     out_lbl = np.zeros((num_agents, max_n), dtype=np.int32)
@@ -64,9 +70,7 @@ def stack_uneven_shards(shard_images: List[np.ndarray],
     """Stack pre-split per-user shards (fed-emnist style, uneven sizes)."""
     num_agents = len(shard_images)
     sizes = np.array([len(x) for x in shard_images], dtype=np.int32)
-    max_n = int(sizes.max()) if num_agents else 0
-    if pad_multiple > 1:
-        max_n = ((max_n + pad_multiple - 1) // pad_multiple) * pad_multiple
+    max_n = padded_max_n(sizes, pad_multiple)
     shp = shard_images[0].shape[1:]
     dtype = shard_images[0].dtype
     out_img = np.zeros((num_agents, max_n) + shp, dtype=dtype)

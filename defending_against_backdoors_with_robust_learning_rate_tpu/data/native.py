@@ -1,0 +1,236 @@
+"""ctypes bindings for the native host data runtime (native/fl_host.cc).
+
+The hot device path is XLA; this library covers the host-side setup
+pipeline the reference runs in Python loops (src/utils.py:58-92 partitioner,
+DataLoader collation): label-sorted partitioning and packing agent shards
+into the padded [K, max_n, ...] device layout — threaded C++ behind a C ABI
+(no pybind11 in this image; ctypes only). Dataset decode stays numpy
+(zero-copy frombuffer).
+
+Usage is always optional: every entry point has a numpy twin
+(data/partition.py, data/arrays.py) and callers go through
+`distribute_data`/`pack_shards` wrappers here that fall back transparently
+when the library is unavailable (no compiler, build failure, or
+FL_NATIVE_HOST=0). Parity is asserted in tests/test_native.py.
+
+The library is built on demand with g++ into native/build/ the first time it
+is requested.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC = os.path.join(_REPO_ROOT, "native", "fl_host.cc")
+_LIB = os.path.join(_REPO_ROOT, "native", "build", "libfl_host.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    # build to a unique temp path and rename into place atomically, so a
+    # rebuild never truncates a .so another live process has dlopened and
+    # concurrent builders don't interleave writes
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", _SRC,
+           "-shared", "-pthread", "-o", tmp]
+    try:
+        os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            return False
+        os.replace(tmp, _LIB)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on any failure."""
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed or os.environ.get("FL_NATIVE_HOST", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _lib_failed = True
+            return None
+
+        i8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.fl_distribute_data.restype = ctypes.c_int32
+        lib.fl_distribute_data.argtypes = [i32p, ctypes.c_int64,
+                                           ctypes.c_int32, ctypes.c_int32,
+                                           ctypes.c_int32, i32p, i32p, i64p]
+        lib.fl_pack_shards.restype = ctypes.c_int32
+        lib.fl_pack_shards.argtypes = [i8p, ctypes.c_int64, ctypes.c_int64,
+                                       i32p, i64p, i32p, ctypes.c_int32,
+                                       ctypes.c_int64, i8p, i32p]
+        lib.fl_pack_uneven.restype = ctypes.c_int32
+        lib.fl_pack_uneven.argtypes = [ctypes.POINTER(i8p),
+                                       ctypes.POINTER(i32p), i32p,
+                                       ctypes.c_int32, ctypes.c_int64,
+                                       ctypes.c_int64, i8p, i32p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ------------------------------------------------------------ partition ---
+
+def distribute_data(labels: np.ndarray, num_agents: int,
+                    n_classes: int = 10,
+                    class_per_agent: int = 10) -> Dict[int, List[int]]:
+    """Native label-sorted partitioner; transparently falls back to the
+    numpy implementation (data/partition.py) when the library is missing."""
+    lib = _load()
+    if lib is not None:
+        n = len(labels)
+        lbl = np.ascontiguousarray(labels, dtype=np.int32)
+        counts = np.zeros(num_agents, dtype=np.int32)
+        chunks = np.zeros(num_agents, dtype=np.int32)
+        indices = np.zeros(max(n, 1), dtype=np.int64)
+        rc = lib.fl_distribute_data(_ptr(lbl, ctypes.c_int32), n, num_agents,
+                                    n_classes, class_per_agent,
+                                    _ptr(counts, ctypes.c_int32),
+                                    _ptr(chunks, ctypes.c_int32),
+                                    _ptr(indices, ctypes.c_int64))
+        if rc == 0:
+            # the Python dict has a key for an agent iff it dealt >= 1 chunk
+            # (even an empty one) — mirror that exactly
+            out: Dict[int, List[int]] = {}
+            pos = 0
+            for a in range(num_agents):
+                c = int(counts[a])
+                if chunks[a] > 0:
+                    out[a] = indices[pos:pos + c].tolist()
+                pos += c
+            return out
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        partition)
+    return partition.distribute_data(labels, num_agents, n_classes,
+                                     class_per_agent)
+
+
+# ----------------------------------------------------------------- pack ---
+
+def pack_shards(images: np.ndarray, labels: np.ndarray,
+                user_groups: Dict[int, Sequence[int]], num_agents: int,
+                pad_multiple: int = 1):
+    """Native padded gather into the [K, max_n, ...] layout; falls back to
+    data/arrays.stack_agent_shards when unavailable."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
+        AgentShards, padded_max_n, stack_agent_shards)
+
+    lib = _load()
+    # the numpy twin raises on labels/images length mismatch; don't let the
+    # native path read past the labels buffer instead
+    if (lib is None or not images.flags.c_contiguous
+            or len(labels) != images.shape[0]):
+        return stack_agent_shards(images, labels, user_groups, num_agents,
+                                  pad_multiple)
+    sizes = np.array([len(user_groups.get(a, ())) for a in range(num_agents)],
+                     dtype=np.int32)
+    max_n = padded_max_n(sizes, pad_multiple)
+    if max_n == 0:
+        return stack_agent_shards(images, labels, user_groups, num_agents,
+                                  pad_multiple)
+    indices = np.concatenate(
+        [np.asarray(list(user_groups.get(a, ())), dtype=np.int64)
+         for a in range(num_agents)]) if sizes.sum() else np.zeros(
+             1, np.int64)
+    item_bytes = int(np.prod(images.shape[1:])) * images.dtype.itemsize
+    out_img = np.zeros((num_agents, max_n) + images.shape[1:],
+                       dtype=images.dtype)
+    out_lbl = np.zeros((num_agents, max_n), dtype=np.int32)
+    lbl32 = np.ascontiguousarray(labels, dtype=np.int32)
+    rc = lib.fl_pack_shards(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        images.shape[0], item_bytes,
+        _ptr(lbl32, ctypes.c_int32), _ptr(indices, ctypes.c_int64),
+        _ptr(sizes, ctypes.c_int32), num_agents, max_n,
+        out_img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        _ptr(out_lbl, ctypes.c_int32))
+    if rc != 0:
+        return stack_agent_shards(images, labels, user_groups, num_agents,
+                                  pad_multiple)
+    return AgentShards(out_img, out_lbl, sizes)
+
+
+def pack_uneven(shard_images: List[np.ndarray], shard_labels: List[np.ndarray],
+                pad_multiple: int = 1):
+    """Native padded stack of pre-split per-user shards (fed-emnist)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
+        AgentShards, padded_max_n, stack_uneven_shards)
+
+    lib = _load()
+    num_agents = len(shard_images)
+    # the native path memcpy's raw bytes: every shard must share the first
+    # shard's dtype and per-item shape, and every label array must match its
+    # image shard's length — else fall back to the value-casting numpy path
+    # (which raises on genuine mismatches)
+    if (lib is None or num_agents == 0
+            or len(shard_labels) != num_agents
+            or any(x.dtype != shard_images[0].dtype
+                   or x.shape[1:] != shard_images[0].shape[1:]
+                   for x in shard_images)
+            or any(len(y) != len(x)
+                   for x, y in zip(shard_images, shard_labels))):
+        return stack_uneven_shards(shard_images, shard_labels, pad_multiple)
+    imgs = [np.ascontiguousarray(x) for x in shard_images]
+    lbls = [np.ascontiguousarray(y, dtype=np.int32) for y in shard_labels]
+    sizes = np.array([len(x) for x in imgs], dtype=np.int32)
+    max_n = padded_max_n(sizes, pad_multiple)
+    if max_n == 0:
+        return stack_uneven_shards(shard_images, shard_labels, pad_multiple)
+    dtype = imgs[0].dtype
+    item_bytes = int(np.prod(imgs[0].shape[1:])) * dtype.itemsize
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    img_ptrs = (u8p * num_agents)(*[x.ctypes.data_as(u8p) for x in imgs])
+    lbl_ptrs = (i32p * num_agents)(*[y.ctypes.data_as(i32p) for y in lbls])
+    out_img = np.zeros((num_agents, max_n) + imgs[0].shape[1:], dtype=dtype)
+    out_lbl = np.zeros((num_agents, max_n), dtype=np.int32)
+    rc = lib.fl_pack_uneven(img_ptrs, lbl_ptrs, _ptr(sizes, ctypes.c_int32),
+                            num_agents, item_bytes, max_n,
+                            out_img.ctypes.data_as(u8p),
+                            _ptr(out_lbl, ctypes.c_int32))
+    if rc != 0:
+        return stack_uneven_shards(shard_images, shard_labels, pad_multiple)
+    return AgentShards(out_img, out_lbl, sizes)

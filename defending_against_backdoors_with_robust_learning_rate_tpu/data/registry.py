@@ -69,13 +69,15 @@ def _norm_arrays(data: str) -> Tuple[np.ndarray, np.ndarray]:
 # ---------------------------------------------------------------- loaders ---
 
 def _read_idx(path: str) -> np.ndarray:
-    """Parse an IDX file (optionally gzipped) — the raw MNIST-family format."""
+    """Parse an IDX file (optionally gzipped) — the raw MNIST-family format.
+    numpy frombuffer is zero-copy over the payload."""
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
-        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
-        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        data = np.frombuffer(f.read(), dtype=np.uint8)
-    return data.reshape(dims)
+        buf = f.read()
+    zero, dtype_code, ndim = struct.unpack(">HBB", buf[:4])
+    dims = struct.unpack(">" + "I" * ndim, buf[4:4 + 4 * ndim])
+    return np.frombuffer(buf, dtype=np.uint8,
+                         offset=4 + 4 * ndim).reshape(dims)
 
 
 def _find(path_candidates) -> Optional[str]:
@@ -267,10 +269,11 @@ def get_federated_data(cfg) -> FederatedData:
 
     Mirrors the setup phase of src/federated.py:33-56.
     """
-    from defending_against_backdoors_with_robust_learning_rate_tpu.data.partition import (
-        distribute_data)
-    from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
-        stack_agent_shards, stack_uneven_shards)
+    # partition + pack go through the native host runtime when available
+    # (native/fl_host.cc via data/native.py), numpy otherwise — identical
+    # outputs either way (tests/test_native.py)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        native)
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack.poison import (
         poison_agent_shards, build_poisoned_val)
 
@@ -279,13 +282,13 @@ def get_federated_data(cfg) -> FederatedData:
     # pad shards to a multiple of the batch size so the client's
     # [n_batches, bs] reshape is exact (fl/client.py)
     if isinstance(train, list):     # fedemnist-style per-user shards
-        shards = stack_uneven_shards([s[0] for s in train],
-                                     [s[1] for s in train],
-                                     pad_multiple=cfg.bs)
+        shards = native.pack_uneven([s[0] for s in train],
+                                    [s[1] for s in train],
+                                    pad_multiple=cfg.bs)
     else:
-        groups = distribute_data(train.labels, cfg.num_agents,
-                                 n_classes=cfg.n_classes)
-        shards = stack_agent_shards(train.images, train.labels, groups,
+        groups = native.distribute_data(train.labels, cfg.num_agents,
+                                        n_classes=cfg.n_classes)
+        shards = native.pack_shards(train.images, train.labels, groups,
                                     cfg.num_agents, pad_multiple=cfg.bs)
 
     imgs, lbls, pmask = poison_agent_shards(shards.images, shards.labels,

@@ -63,12 +63,16 @@ def restore(ckpt_dir: str, params_like
     try:
         state = _ckptr().restore(path, target)
     except ValueError as e:
-        # checkpoint written before cum_net_mov existed: restore without it.
-        # Any other structural mismatch (e.g. params shape change) re-raises.
-        if "cum_net_mov" not in str(e):
-            raise
-        del target["cum_net_mov"]
-        state = _ckptr().restore(path, target)
+        # checkpoint written before cum_net_mov existed: retry with the
+        # legacy target. A genuine structural mismatch (e.g. params shape
+        # change) fails both attempts and re-raises the ORIGINAL error —
+        # no dependence on orbax's error-message wording.
+        legacy = dict(target)
+        del legacy["cum_net_mov"]
+        try:
+            state = dict(_ckptr().restore(path, legacy))
+        except ValueError:
+            raise e
         state["cum_net_mov"] = np.asarray(0.0, np.float64)
     key = jax.random.wrap_key_data(state["key"])
     return (int(state["round"]), state["params"], key,

@@ -1,0 +1,171 @@
+// fl_host — native host-side data runtime for the TPU FL framework.
+//
+// The reference's host pipeline is Python: torchvision loaders,
+// `distribute_data`'s per-index Python loops (reference src/utils.py:58-92),
+// and per-batch DataLoader collation (src/agent.py:28). The TPU build moves
+// all per-step data work onto the device; what remains on the host is the
+// one-time setup pipeline — dataset decode, label-sorted partitioning, and
+// packing per-agent shards into the padded [K, max_n, ...] device layout
+// (data/arrays.py). This library implements that pipeline natively:
+//
+//   fl_distribute_data     label-sorted strided-chunk dealing partitioner,
+//                          bit-identical to data/partition.py
+//   fl_pack_shards         padded gather of agent shards, threaded over agents
+//   fl_pack_uneven         padded stack of pre-split (fed-emnist) user shards
+//
+// (Dataset decode stays in Python: numpy's zero-copy frombuffer already
+// beats any memcpy-based native decode.)
+//
+// C ABI only — loaded from Python via ctypes (no pybind11 in this image).
+// Every function returns 0 on success or a negative error code; the Python
+// wrapper (data/native.py) falls back to the numpy path on any failure.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kOk = 0;
+constexpr int kErrBadArg = -3;
+
+void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nt = std::max<int64_t>(1, std::min<int64_t>(hw ? hw : 1, n));
+  if (nt == 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = (n + nt - 1) / nt;
+  for (int64_t t = 0; t < nt; ++t) {
+    int64_t lo = t * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back(fn, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Label-sorted strided-chunk partitioner — bit-identical to
+// data/partition.py::distribute_data (itself semantics-parity with reference
+// src/utils.py:58-92): per-class ascending index lists, split into
+// `slice_size` strided chunks v[i::slice_size], dealt `class_per_agent`
+// chunks per agent walking classes 0..n_classes-1 with front-chunk removal.
+//
+// Outputs: out_counts[num_agents] per-agent index counts, out_chunks
+// [num_agents] per-agent dealt-chunk counts (the Python dict has a key for
+// an agent iff it dealt >= 1 chunk, even an empty one), and out_indices
+// (capacity n) holding every agent's indices back-to-back in agent order.
+int32_t fl_distribute_data(const int32_t* labels, int64_t n, int32_t num_agents,
+                           int32_t n_classes, int32_t class_per_agent,
+                           int32_t* out_counts, int32_t* out_chunks,
+                           int64_t* out_indices) {
+  if (n <= 0 || num_agents <= 0 || n_classes <= 0 || class_per_agent <= 0)
+    return kErrBadArg;
+  if (num_agents == 1) {
+    out_counts[0] = int32_t(n);
+    out_chunks[0] = 1;
+    for (int64_t i = 0; i < n; ++i) out_indices[i] = i;
+    return kOk;
+  }
+  int64_t shard_size = n / (int64_t(num_agents) * class_per_agent);
+  if (shard_size == 0) return kErrBadArg;  // Python raises ZeroDivisionError
+  int64_t slice_size = (n / n_classes) / shard_size;
+  if (slice_size == 0) return kErrBadArg;
+
+  // per-class ascending index lists (stable sort equivalent)
+  std::vector<std::vector<int64_t>> per_class(n_classes);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t c = labels[i];
+    if (c < 0 || c >= n_classes) return kErrBadArg;
+    per_class[c].push_back(i);
+  }
+  // chunk i of class c = per_class[c][i::slice_size]; dealing removes the
+  // front not-yet-taken chunk, so track the next chunk id per class.
+  // A class that is PRESENT but small still owns slice_size (possibly
+  // empty) chunks and consumes a class_ctr slot when dealt; a class with
+  // ZERO samples owns no chunks and is skipped — exactly the Python
+  // partitioner's `len(labels_dict[j]) > 0` behavior.
+  std::vector<int64_t> next_chunk(n_classes, 0);
+  std::vector<int64_t> total_chunks(n_classes);
+  for (int32_t c = 0; c < n_classes; ++c)
+    total_chunks[c] = per_class[c].empty() ? 0 : slice_size;
+  int64_t w = 0;
+  for (int32_t a = 0; a < num_agents; ++a) {
+    int32_t class_ctr = 0;
+    int64_t w0 = w;
+    for (int32_t c = 0; c < n_classes; ++c) {
+      if (class_ctr == class_per_agent) break;
+      if (next_chunk[c] >= total_chunks[c]) continue;  // class exhausted
+      int64_t i = next_chunk[c]++;
+      const auto& v = per_class[c];
+      for (int64_t j = i; j < int64_t(v.size()); j += slice_size)
+        out_indices[w++] = v[j];
+      ++class_ctr;
+    }
+    out_counts[a] = int32_t(w - w0);
+    out_chunks[a] = class_ctr;
+  }
+  return kOk;
+}
+
+// Padded gather: out_images[K, max_n, item] / out_labels[K, max_n] from the
+// flat dataset, one agent's index list at a time (indices/counts as produced
+// by fl_distribute_data). Padding rows stay zero; caller pre-zeroes outputs.
+// Threaded over agents.
+int32_t fl_pack_shards(const uint8_t* images, int64_t n_items,
+                       int64_t item_bytes, const int32_t* labels,
+                       const int64_t* indices, const int32_t* counts,
+                       int32_t num_agents, int64_t max_n, uint8_t* out_images,
+                       int32_t* out_labels) {
+  if (item_bytes <= 0 || num_agents <= 0 || max_n <= 0) return kErrBadArg;
+  std::vector<int64_t> offsets(num_agents + 1, 0);
+  for (int32_t a = 0; a < num_agents; ++a) {
+    if (counts[a] < 0 || counts[a] > max_n) return kErrBadArg;
+    offsets[a + 1] = offsets[a] + counts[a];
+  }
+  // bounds-check every index up front (numpy fancy-indexing would raise)
+  for (int64_t j = 0; j < offsets[num_agents]; ++j)
+    if (indices[j] < 0 || indices[j] >= n_items) return kErrBadArg;
+  parallel_for(num_agents, [&](int64_t lo, int64_t hi) {
+    for (int64_t a = lo; a < hi; ++a) {
+      uint8_t* img_row = out_images + a * max_n * item_bytes;
+      int32_t* lbl_row = out_labels + a * max_n;
+      const int64_t* idx = indices + offsets[a];
+      for (int64_t j = 0; j < counts[a]; ++j) {
+        std::memcpy(img_row + j * item_bytes, images + idx[j] * item_bytes,
+                    item_bytes);
+        lbl_row[j] = labels[idx[j]];
+      }
+    }
+  });
+  return kOk;
+}
+
+// Padded stack of pre-split per-user shards (fed-emnist: uneven sizes).
+// shard_images[a] points at counts[a] items of item_bytes each.
+int32_t fl_pack_uneven(const uint8_t* const* shard_images,
+                       const int32_t* const* shard_labels,
+                       const int32_t* counts, int32_t num_agents,
+                       int64_t item_bytes, int64_t max_n, uint8_t* out_images,
+                       int32_t* out_labels) {
+  if (item_bytes <= 0 || num_agents <= 0 || max_n <= 0) return kErrBadArg;
+  parallel_for(num_agents, [&](int64_t lo, int64_t hi) {
+    for (int64_t a = lo; a < hi; ++a) {
+      std::memcpy(out_images + a * max_n * item_bytes, shard_images[a],
+                  int64_t(counts[a]) * item_bytes);
+      for (int64_t j = 0; j < counts[a]; ++j)
+        out_labels[a * max_n + j] = shard_labels[a][j];
+    }
+  });
+  return kOk;
+}
+
+}  // extern "C"

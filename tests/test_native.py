@@ -1,0 +1,140 @@
+"""Native host data runtime (native/fl_host.cc) parity vs the numpy path.
+
+The native library is built on demand with g++; all tests skip when no
+compiler is available so CI without a toolchain stays green."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+    arrays, native, partition)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native host library unavailable")
+
+
+def _rand_labels(n, n_classes=10, seed=0):
+    return np.random.default_rng(seed).integers(0, n_classes, size=n,
+                                                dtype=np.int64)
+
+
+@pytest.mark.parametrize("n,num_agents", [(1000, 10), (640, 8), (990, 33)])
+def test_distribute_data_matches_python(n, num_agents):
+    labels = _rand_labels(n)
+    got = native.distribute_data(labels, num_agents)
+    want = partition.distribute_data(labels, num_agents)
+    assert set(got) == set(want)
+    for a in want:
+        assert got[a] == want[a], f"agent {a} differs"
+
+
+def test_distribute_data_single_agent():
+    labels = _rand_labels(64)
+    assert native.distribute_data(labels, 1) == {0: list(range(64))}
+
+
+def test_distribute_data_missing_classes():
+    # a class with zero samples is skipped in the dealing loop
+    labels = np.where(_rand_labels(1000) == 3, 4, _rand_labels(1000))
+    got = native.distribute_data(labels, 10)
+    want = partition.distribute_data(labels, 10)
+    assert got == want
+
+
+def test_distribute_data_missing_class_binding_quota():
+    """With class_per_agent < n_classes the quota binds: an absent class
+    must NOT consume a class_ctr slot (it has no chunks), while a present
+    but small class must (its empty strided chunks still count) — the exact
+    `len(labels_dict[j]) > 0` semantics of the Python partitioner."""
+    labels = _rand_labels(1000)
+    labels = np.where(labels == 3, 4, labels)      # class 3 absent
+    got = native.distribute_data(labels, 10, class_per_agent=5)
+    want = partition.distribute_data(labels, 10, class_per_agent=5)
+    assert got == want
+
+
+def test_pack_shards_out_of_range_index_matches_numpy_error():
+    """An index past the dataset must not silently pack garbage: the native
+    path rejects it and the wrapper falls back to numpy, which raises."""
+    images = np.zeros((10, 4, 4, 1), dtype=np.uint8)
+    labels = np.zeros(10, dtype=np.int64)
+    with pytest.raises(IndexError):
+        native.pack_shards(images, labels, {0: [0, 99]}, 1)
+
+
+def test_pack_uneven_mixed_dtypes_falls_back_to_numpy():
+    """Shards with differing dtypes take the value-casting numpy path, so
+    native presence never changes results."""
+    a = np.ones((4, 2, 2, 1), dtype=np.float32)
+    b = np.full((3, 2, 2, 1), 2.0, dtype=np.float64)
+    lbls = [np.zeros(4, np.int64), np.ones(3, np.int64)]
+    got = native.pack_uneven([a, b], lbls, pad_multiple=4)
+    want = arrays.stack_uneven_shards([a, b], lbls, pad_multiple=4)
+    np.testing.assert_array_equal(got.images, want.images)
+    np.testing.assert_array_equal(got.labels, want.labels)
+
+
+def test_pack_shards_matches_python():
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, size=(500, 28, 28, 1), dtype=np.uint8)
+    labels = _rand_labels(500)
+    groups = partition.distribute_data(labels, 10)
+    got = native.pack_shards(images, labels, groups, 10, pad_multiple=32)
+    want = arrays.stack_agent_shards(images, labels, groups, 10,
+                                     pad_multiple=32)
+    np.testing.assert_array_equal(got.images, want.images)
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_array_equal(got.sizes, want.sizes)
+
+
+def test_pack_shards_float_images():
+    rng = np.random.default_rng(2)
+    images = rng.normal(size=(100, 8, 8, 3)).astype(np.float32)
+    labels = _rand_labels(100)
+    groups = partition.distribute_data(labels, 5)
+    got = native.pack_shards(images, labels, groups, 5, pad_multiple=16)
+    want = arrays.stack_agent_shards(images, labels, groups, 5,
+                                     pad_multiple=16)
+    np.testing.assert_array_equal(got.images, want.images)
+    np.testing.assert_array_equal(got.labels, want.labels)
+
+
+def test_pack_uneven_matches_python():
+    rng = np.random.default_rng(3)
+    shard_imgs = [rng.normal(size=(int(k), 28, 28, 1)).astype(np.float32)
+                  for k in rng.integers(5, 40, size=12)]
+    shard_lbls = [_rand_labels(len(x), seed=i)
+                  for i, x in enumerate(shard_imgs)]
+    got = native.pack_uneven(shard_imgs, shard_lbls, pad_multiple=64)
+    want = arrays.stack_uneven_shards(shard_imgs, shard_lbls, pad_multiple=64)
+    np.testing.assert_array_equal(got.images, want.images)
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_array_equal(got.sizes, want.sizes)
+
+
+def test_distribute_data_zero_count_agent_keys_match():
+    """An agent that deals only EMPTY chunks still gets a dict key (the
+    Python defaultdict materializes it); an agent that deals nothing gets no
+    key — native must mirror both."""
+    # 31 samples of class 0, 969 of class 1: class 0's strided chunks are
+    # mostly empty once slice_size exceeds 31
+    labels = np.concatenate([np.zeros(31, np.int64), np.ones(969, np.int64)])
+    got = native.distribute_data(labels, 32, class_per_agent=1)
+    want = partition.distribute_data(labels, 32, class_per_agent=1)
+    assert got == want
+
+
+def test_read_idx(tmp_path):
+    """registry._read_idx over a gzipped IDX file."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        _read_idx)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(13, 28, 28), dtype=np.uint8)
+    buf = struct.pack(">HBB", 0, 0x08, 3) + struct.pack(">III", 13, 28, 28) \
+        + data.tobytes()
+    p = tmp_path / "imgs-idx3-ubyte.gz"
+    p.write_bytes(gzip.compress(buf))
+    np.testing.assert_array_equal(_read_idx(str(p)), data)
