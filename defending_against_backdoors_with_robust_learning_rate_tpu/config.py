@@ -54,6 +54,11 @@ class Config:
     # --- TPU-native additions ---
     platform: str = ""              # "" = default backend; "cpu"/"tpu" override
     seed: int = 0
+    # multi-host (DCN) rendezvous — one process per host; all empty/0 means
+    # single-process (or cloud auto-detection inside jax.distributed)
+    coordinator: str = ""           # host:port of process 0
+    num_processes: int = 0          # total processes in the job
+    process_id: int = -1            # this process's id; -1 = auto
     arch: str = "auto"              # auto | cnn | resnet9
     dtype: str = "f32"              # f32 | bf16 (compute dtype on the MXU)
     mesh: int = 1                   # devices on the `agents` mesh axis; 0 = all
@@ -165,6 +170,13 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--arch", type=str, default=d.arch,
                    help="auto|cnn|resnet9 (BASELINE.json configs[3-4])")
     p.add_argument("--dtype", type=str, default=d.dtype, help="f32|bf16")
+    p.add_argument("--coordinator", type=str, default=d.coordinator,
+                   help="multi-host: host:port of process 0 "
+                        "(jax.distributed rendezvous)")
+    p.add_argument("--num_processes", type=int, default=d.num_processes,
+                   help="multi-host: total processes (one per host)")
+    p.add_argument("--process_id", type=int, default=d.process_id,
+                   help="multi-host: this process's id; -1 = auto")
     p.add_argument("--mesh", type=int, default=d.mesh,
                    help="devices on the `agents` mesh axis (0=all local devices)")
     p.add_argument("--chain", type=int, default=d.chain,

@@ -1,0 +1,95 @@
+"""Multi-host (DCN) support — the scale-out path for v5e-256-class meshes.
+
+The reference has no distributed backend at all (SURVEY.md 2.2: no
+torch.distributed/NCCL/MPI; its only "multi-GPU" story is backgrounding
+independent processes, src/runner.sh:12-18). Here multi-host is first-class:
+
+- one process per host, rendezvoused with `jax.distributed.initialize`
+  (driven by --coordinator/--num_processes/--process_id flags, or the
+  standard cloud env auto-detection when the flags are absent);
+- ONE global 1-D `agents` mesh over all hosts' devices, ordered by
+  `mesh_utils.create_hybrid_device_mesh` so that neighboring mesh positions
+  are ICI neighbors and the DCN (inter-host) hops are minimized — the
+  psum/all_gather/all_to_all collectives in parallel/rounds.py then ride
+  ICI within a slice and DCN only at slice boundaries;
+- process-local numpy arrays are promoted to global jax.Arrays (replicated
+  for params/datasets — every host loads the identical seeded data — and
+  agents-sharded for per-agent stacks).
+
+Single-process runs degrade transparently: every helper is a no-op or the
+trivial local construction, so the same driver code serves a laptop CPU, a
+single TPU chip, a v5e-8 slice, and a multi-host pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+    AGENTS_AXIS)
+
+
+def maybe_initialize(coordinator: str = "", num_processes: int = 0,
+                     process_id: int = -1) -> None:
+    """Rendezvous this process into the multi-host job.
+
+    With explicit flags, passes them through; with no flags on a cloud TPU
+    pod, `jax.distributed.initialize()` auto-detects from the environment.
+    Safe to skip entirely for single-process runs (the default)."""
+    if num_processes > 1 or coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator or None,
+            num_processes=num_processes or None,
+            process_id=process_id if process_id >= 0 else None)
+
+
+def is_lead() -> bool:
+    """True on the process that owns logging/metrics/checkpoint writes."""
+    return jax.process_index() == 0
+
+
+def global_agents_mesh(n_devices: int = 0) -> Mesh:
+    """A 1-D `agents` mesh over the job's GLOBAL device list.
+
+    Multi-host: hybrid ICI/DCN ordering via mesh_utils, so the agent axis
+    walks each host's slice contiguously before crossing DCN. The mesh MUST
+    span every process (each host can only run SPMD programs whose mesh
+    includes its addressable devices), so a partial n_devices is rejected
+    rather than silently excluding hosts. Single-host: parallel/mesh
+    construction."""
+    if jax.process_count() == 1:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+            make_mesh)
+        return make_mesh(n_devices)
+    total = jax.device_count()
+    if n_devices not in (0, total):
+        raise ValueError(
+            f"multi-host mesh must span all {total} global devices, got "
+            f"n_devices={n_devices}; pick num_agents/agent_frac so the "
+            f"per-round participant count is divisible by {total}")
+    from jax.experimental import mesh_utils
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(jax.local_device_count(),),
+        dcn_mesh_shape=(jax.process_count(),)).reshape(-1)
+    return Mesh(devices, (AGENTS_AXIS,))
+
+
+def put_replicated(mesh: Mesh, x):
+    """Promote (a pytree of) process-local arrays, identical on every host
+    (seeded data / init), to fully-replicated global jax.Arrays."""
+    sharding = NamedSharding(mesh, P())
+
+    def one(a):
+        a = np.asarray(a)
+        if jax.process_count() == 1:
+            return jax.device_put(a, sharding)
+        from jax.experimental import multihost_utils
+        return multihost_utils.host_local_array_to_global_array(
+            a, mesh, P())
+    return jax.tree_util.tree_map(one, x)
+
+

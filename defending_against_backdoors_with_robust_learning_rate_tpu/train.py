@@ -34,7 +34,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry i
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
     checkpoint as ckpt)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
-    MetricsWriter, run_name)
+    MetricsWriter, NullWriter, run_name)
 
 # above this many stacked-array bytes the driver switches to host-side
 # per-round shard gathering (the fedemnist path: 3383 users, SURVEY.md 7.3.2)
@@ -73,12 +73,31 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     chain_n = max(1, min(cfg.chain,
                          cfg.snap - (1 if cfg.diagnostics else 0)))
     if n_mesh > 1:
-        mesh = make_mesh(n_mesh)
+        if jax.process_count() > 1:
+            # multi-host: one global agents mesh, DCN-aware device order.
+            # The mesh must span every host's devices, so the blocking
+            # policy cannot shrink it — the participant count has to divide
+            # over the full pod (global_agents_mesh raises otherwise).
+            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                multihost)
+            n_mesh = jax.device_count()
+            if cfg.agents_per_round % n_mesh != 0:
+                raise ValueError(
+                    f"agents_per_round={cfg.agents_per_round} must be "
+                    f"divisible by the pod's {n_mesh} devices for a "
+                    f"multi-host run; adjust --num_agents/--agent_frac")
+            mesh = multihost.global_agents_mesh(n_mesh)
+            arrays = multihost.put_replicated(
+                mesh, (fed.train.images, fed.train.labels, fed.train.sizes))
+            params = multihost.put_replicated(mesh, params)
+        else:
+            mesh = make_mesh(n_mesh)
+            arrays = (jnp.asarray(fed.train.images),
+                      jnp.asarray(fed.train.labels),
+                      jnp.asarray(fed.train.sizes))
         print(f"[mesh] {n_mesh} devices on the `agents` axis "
-              f"({cfg.agents_per_round // n_mesh} agents/device)")
-        arrays = (jnp.asarray(fed.train.images),
-                  jnp.asarray(fed.train.labels),
-                  jnp.asarray(fed.train.sizes))
+              f"({cfg.agents_per_round // n_mesh} agents/device), "
+              f"{jax.process_count()} process(es)")
         round_fn = make_sharded_round_fn(plain_cfg, model, norm, mesh, *arrays)
         diag_round_fn = (make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
                          if cfg.diagnostics else round_fn)
@@ -157,8 +176,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     pval = tuple(map(jnp.asarray, pad_eval_set(
         fed.pval_images, fed.pval_labels, cfg.eval_bs)))
 
+    lead = jax.process_index() == 0
     if writer is None:
-        writer = MetricsWriter(cfg.log_dir, run_name(cfg), cfg.tensorboard)
+        writer = (MetricsWriter(cfg.log_dir, run_name(cfg), cfg.tensorboard)
+                  if lead else NullWriter())
 
     base_key = jax.random.PRNGKey(cfg.seed)
     start_round, cum_poison_acc, cum_net_mov = 0, 0.0, 0.0
@@ -167,10 +188,15 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         if restored is not None:
             start_round, params, base_key, cum_poison_acc, cum_net_mov = \
                 restored
-            params = jax.device_put(params)
+            if jax.process_count() > 1 and n_mesh > 1:
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                    multihost)
+                params = multihost.put_replicated(mesh, params)
+            else:
+                params = jax.device_put(params)
             print(f"[ckpt] resumed from round {start_round}")
 
-    if cfg.profile_dir:
+    if cfg.profile_dir and lead:
         jax.profiler.start_trace(cfg.profile_dir)
 
     summary: Dict = {}
@@ -255,12 +281,15 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             summary = {"round": rnd, "val_loss": val_loss, "val_acc": val_acc,
                        "poison_loss": poison_loss, "poison_acc": poison_acc,
                        "rounds_per_sec": rounds_done / elapsed}
+            # every process calls save: orbax runs cross-process barriers
+            # inside and writes replicated data from the primary only —
+            # lead-gating it would deadlock a multi-host job
             if cfg.checkpoint_dir:
                 ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
                           cum_poison_acc, cum_net_mov)
         writer.flush()
 
-    if cfg.profile_dir:
+    if cfg.profile_dir and lead:
         jax.profiler.stop_trace()
 
     elapsed = time.perf_counter() - t_loop
@@ -280,6 +309,11 @@ def main(argv=None):
         # must land before any backend use; this environment's sitecustomize
         # pins a platform at interpreter start, so env vars alone are too late
         jax.config.update("jax_platforms", cfg.platform)
+    if cfg.num_processes > 1 or cfg.coordinator:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+            multihost)
+        multihost.maybe_initialize(cfg.coordinator, cfg.num_processes,
+                                   cfg.process_id)
     return run(cfg)
 
 

@@ -31,6 +31,20 @@ def run_name(cfg) -> str:
             f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}")
 
 
+class NullWriter:
+    """No-op writer — non-lead processes of a multi-host job use this so
+    only process 0 touches the log directory."""
+
+    def scalar(self, tag: str, value, step: int) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
 class MetricsWriter:
     """JSONL always; TensorBoard when available and enabled."""
 

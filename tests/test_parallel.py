@@ -91,6 +91,30 @@ def test_param_shard_transpose_roundtrip():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(expect))
 
 
+def test_multihost_helpers_single_process_degrade():
+    """multihost helpers must be transparent for single-process jobs: the
+    global mesh equals the local mesh, put_replicated yields replicated
+    global arrays the sharded round fn accepts."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+        multihost)
+
+    assert jax.process_count() == 1
+    assert multihost.is_lead()
+    mesh = multihost.global_agents_mesh(4)
+    assert mesh.devices.size == 4 and mesh.axis_names == ("agents",)
+
+    cfg, model, params, norm, arrays = _setup("avg", num_corrupt=0)
+    g_params = multihost.put_replicated(mesh, params)
+    leaf = jax.tree_util.tree_leaves(g_params)[0]
+    assert leaf.sharding.is_equivalent_to(
+        NamedSharding(mesh, P()), leaf.ndim)
+    g_arrays = multihost.put_replicated(mesh, arrays)
+    sharded = make_sharded_round_fn(cfg, model, norm, mesh, *g_arrays)
+    p, info = sharded(g_params, jax.random.PRNGKey(0))
+    assert np.isfinite(float(info["train_loss"]))
+
+
 def test_sharded_multiround_trains():
     cfg, model, params, norm, arrays = _setup("avg", num_corrupt=0)
     mesh = make_mesh(4)
