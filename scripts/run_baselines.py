@@ -56,8 +56,11 @@ def run_cfg(name, cfg, snap_rounds):
                 "val_acc": row.get("Validation/Accuracy"),
                 "poison_acc": row.get("Poison/Poison_Accuracy"),
             }
+    import jax
+    dev = jax.devices()[0]
     return {"name": name, "summary": summary, "milestones": milestones,
-            "wall_s": round(wall, 1)}
+            "wall_s": round(wall, 1),
+            "device": f"{dev.device_kind} ({dev.platform})"}
 
 
 def main():
@@ -66,6 +69,12 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes for smoke-testing this script")
     ap.add_argument("--out", default="RESULTS.md")
+    ap.add_argument("--only", default="",
+                    help="substring filter: run only matching configs and "
+                         "merge into the existing results.json")
+    ap.add_argument("--regen", action="store_true",
+                    help="rewrite RESULTS.md from the existing results.json "
+                         "without running anything (no backend touched)")
     args = ap.parse_args()
 
     from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
@@ -103,11 +112,12 @@ def main():
                                        pattern_type="plus",
                                        robustLR_threshold=8, **cf)),
         ]
-        # fedemnist-shaped non-IID: many agents, partial sampling
-        # (reference src/runner.sh:34-38 scaled down from 3383 users)
+        # fedemnist-shaped non-IID: many agents, partial sampling, deep
+        # local training (reference src/runner.sh:34-38: local_ep=10, 10%
+        # corrupt, ~33 sampled/round — scaled down from 3383 users)
         fe = dict(data="fedemnist", num_agents=128, agent_frac=0.25,
-                  local_ep=2, bs=64, rounds=min(R, 100), snap=snap,
-                  chain=chain, seed=0, synth_train_size=8192,
+                  local_ep=10, bs=64, rounds=min(R, 100), snap=snap,
+                  chain=chain, seed=0, synth_train_size=32768,
                   synth_val_size=1024, tensorboard=False,
                   data_dir="./data")
         configs += [
@@ -118,17 +128,38 @@ def main():
         ]
 
     snap_rounds = [20, 50, 100, R]
+    prior = []
+    if (args.only or args.regen) and os.path.exists("results.json"):
+        with open("results.json") as f:
+            prior = json.load(f)
+        for r in prior:   # JSON round-trip stringifies milestone keys
+            r["milestones"] = {int(k): v
+                               for k, v in r["milestones"].items()}
+    if args.regen:
+        configs = []
+    elif args.only:
+        configs = [(n, c) for n, c in configs if args.only in n]
+        if not configs:
+            sys.exit(f"--only {args.only!r} matches no config "
+                     f"(note: --quick builds only the fmnist triple)")
     results = []
     for name, cfg in configs:
         print(f"\n=== {name} ===", flush=True)
         results.append(run_cfg(name, cfg, snap_rounds))
         print(json.dumps(results[-1]["summary"]), flush=True)
 
+    ran = {r["name"] for r in results}
+    results = [r for r in prior if r["name"] not in ran] + results
+    order = ["fmnist-clean", "fmnist-attack", "fmnist-attack-rlr",
+             "cifar10-dba-attack", "cifar10-dba-rlr",
+             "fedemnist-attack", "fedemnist-attack-rlr"]
+    results.sort(key=lambda r: order.index(r["name"])
+                 if r["name"] in order else len(order))
     with open("results.json", "w") as f:
         json.dump(results, f, indent=1)
 
-    import jax
-    dev = jax.devices()[0]
+    device = next((r["device"] for r in results if r.get("device")),
+                  "unknown")
     lines = [
         "# RESULTS — regenerated baseline",
         "",
@@ -145,7 +176,7 @@ def main():
         "2. the backdoor succeeds without defense (poison accuracy high),",
         "3. RLR collapses the backdoor at small clean-accuracy cost.",
         "",
-        f"Device: `{dev.device_kind}` ({dev.platform}); configs are the "
+        f"Device: `{device}`; configs are the "
         "reference's canonical triples (src/runner.sh:12-38), "
         f"{R} rounds, eval every {snap} rounds, chained dispatch "
         f"({chain} rounds/XLA program).",

@@ -68,6 +68,34 @@ def test_backdoor_succeeds_without_defense_and_rlr_collapses_it():
         f"RLR did not collapse backdoor: {poison_d} vs undefended {poison_a}")
 
 
+def test_host_sampled_mode_trains():
+    """The host-sampled path (fedemnist: shard stacks too big for HBM; the
+    driver gathers each round's sampled shards host-side) runs rounds with
+    fixed [m, ...] shapes and learns."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn_host)
+
+    cfg = BASE
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(cfg.seed))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    host_fn = make_round_fn_host(cfg, model, norm)
+
+    rng = np.random.default_rng(0)
+    losses = []
+    key = jax.random.PRNGKey(9)
+    for rnd in range(4):
+        key, sub = jax.random.split(key)
+        ids = rng.choice(cfg.num_agents, cfg.agents_per_round, replace=False)
+        params, info = host_fn(params, sub,
+                               jnp.asarray(fed.train.images[ids]),
+                               jnp.asarray(fed.train.labels[ids]),
+                               jnp.asarray(fed.train.sizes[ids]))
+        losses.append(float(info["train_loss"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
 def test_all_aggregators_run_a_round():
     for aggr in ("avg", "comed", "sign", "krum"):
         cfg = BASE.replace(aggr=aggr, rounds=1)

@@ -1,0 +1,85 @@
+"""Model parity: parameter counts match the reference architectures exactly
+(SURVEY.md 7.2.3 'param-count parity checks').
+
+Reference CNN_MNIST (src/models.py:11-31):
+  conv1 1->32 3x3 (320) + conv2 32->64 3x3 (18,496)
+  + fc1 9216->128 (1,179,776) + fc2 128->10 (1,290) = 1,199,882
+Reference CNN_CIFAR (src/models.py:33-58):
+  conv 3->64 (1,792) + conv 64->128 (73,856) + conv 128->256 (295,168)
+  + fc1 1024->128 (131,200) + fc2 128->256 (33,024) + fc3 256->10 (2,570)
+  = 537,610
+"""
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+    get_model, init_params, param_count)
+
+
+def _build(data, arch, shape):
+    model = get_model(data, arch)
+    params = init_params(model, shape, jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_cnn_mnist_param_count_parity():
+    model, params = _build("fmnist", "cnn", (28, 28, 1))
+    assert param_count(params) == 1_199_882
+
+
+def test_cnn_cifar_param_count_parity():
+    model, params = _build("cifar10", "cnn", (32, 32, 3))
+    assert param_count(params) == 537_610
+
+
+def test_forward_shapes_and_dropout_determinism():
+    for data, arch, shape in [("fmnist", "cnn", (28, 28, 1)),
+                              ("cifar10", "cnn", (32, 32, 3)),
+                              ("cifar10", "resnet9", (32, 32, 3))]:
+        model, params = _build(data, arch, shape)
+        x = jnp.zeros((4,) + shape, jnp.float32)
+        out = model.apply({"params": params}, x, train=False)
+        assert out.shape == (4, 10), (data, arch)
+        assert out.dtype == jnp.float32
+        # train mode with the same dropout key is deterministic
+        rngs = {"dropout": jax.random.PRNGKey(7)}
+        a = model.apply({"params": params}, x + 1.0, train=True, rngs=rngs)
+        b = model.apply({"params": params}, x + 1.0, train=True, rngs=rngs)
+        assert jnp.array_equal(a, b), (data, arch)
+
+
+def test_bf16_compute_round_runs():
+    """--dtype=bf16 (MXU compute dtype) trains a round with finite loss and
+    f32 params (params/update math stays f32; only layer compute is bf16)."""
+    import jax.numpy as jnp
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+
+    cfg = Config(data="synthetic", num_agents=4, bs=16, local_ep=1,
+                 synth_train_size=128, synth_val_size=32, dtype="bf16",
+                 robustLR_threshold=2, num_corrupt=1, poison_frac=1.0)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    rf = make_round_fn(cfg, model, norm, jnp.asarray(fed.train.images),
+                       jnp.asarray(fed.train.labels),
+                       jnp.asarray(fed.train.sizes))
+    new_params, info = rf(params, jax.random.PRNGKey(1))
+    assert jnp.isfinite(info["train_loss"])
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(new_params))
+
+
+def test_resnet9_is_the_north_star_default_for_cifar():
+    """BASELINE.json configs[3-4] use ResNet-9 on cifar10; arch='auto'
+    resolves cifar10 to the faithful CNN (parity) and 'resnet9' opts in."""
+    assert type(get_model("cifar10", "cnn")).__name__ == "CNN_CIFAR"
+    assert type(get_model("cifar10", "resnet9")).__name__ == "ResNet9"
+    assert type(get_model("fmnist", "auto")).__name__ == "CNN_MNIST"
