@@ -72,6 +72,7 @@ class Config:
     eval_bs: int = 1024
     profile_dir: str = ""           # "" disables jax.profiler traces
     use_pallas: bool = False        # fused RLR+aggregate TPU kernel
+    debug_nan: bool = False         # checkify float guards in the round fn
     diagnostics: bool = False       # Norms/* + Sign/* research scalars (C13)
     tensorboard: bool = True        # JSONL metrics always; TB optional
     # synthetic-data knobs (used when `data` is missing on disk or 'synthetic')
@@ -189,6 +190,9 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--eval_bs", type=int, default=d.eval_bs)
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
     p.add_argument("--use_pallas", action="store_true")
+    p.add_argument("--debug_nan", action="store_true",
+                   help="instrument the round program with checkify float "
+                        "checks (raises on the first NaN/inf)")
     p.add_argument("--diagnostics", action="store_true",
                    help="log Norms/* and Sign/* research scalars "
                         "(the reference's dead-code diagnostics, C13)")

@@ -33,6 +33,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry i
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
     checkpoint as ckpt)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
+    assert_finite_params)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
     MetricsWriter, NullWriter, run_name)
 
@@ -147,6 +149,21 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     if chained_fn is not None:
         print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan)")
 
+    if cfg.debug_nan:
+        # sanitizer mode (SURVEY.md section 5.2): float checks compiled into
+        # every round variant; raises on the first NaN/inf produced
+        from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
+            guard_round_fn)
+        print("[guards] checkify float checks enabled (--debug_nan)")
+        if host_sampler is None:
+            round_fn = guard_round_fn(round_fn)
+            diag_round_fn = guard_round_fn(diag_round_fn)
+        else:
+            round_fn_host = guard_round_fn(round_fn_host)
+            diag_round_fn_host = guard_round_fn(diag_round_fn_host)
+        if chained_fn is not None:
+            chained_fn = guard_round_fn(chained_fn)
+
     if cfg.use_pallas:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
             _pallas_applicable)
@@ -256,6 +273,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                     writer.scalar(tag, v, rnd)
 
         if rnd % cfg.snap == 0:
+            # divergence aborts only under --debug_nan; otherwise it warns
+            # and the run keeps recording its (NaN) metrics
+            assert_finite_params(params, where=f"round {rnd}",
+                                 raise_error=cfg.debug_nan)
             val_loss, val_acc, per_class = eval_fn(params, *val)
             poison_loss, poison_acc, _ = eval_fn(params, *pval)
             val_loss, val_acc = float(val_loss), float(val_acc)

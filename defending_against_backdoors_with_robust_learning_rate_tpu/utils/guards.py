@@ -1,0 +1,57 @@
+"""Numerical-health guards — the sanitizer subsystem (SURVEY.md section 5.2).
+
+The reference has no race detection or sanitizers to port (single-threaded,
+single process); the JAX-native equivalent of a sanitizer pass is
+`jax.experimental.checkify`: float checks (NaN/inf) instrumented into the
+compiled round program itself. Behind ``--debug_nan``:
+
+    round_fn = guard_round_fn(round_fn)   # checkify.checkify(..., float_checks)
+    params, info = round_fn(params, key)  # raises on the first NaN/inf
+                                          # produced anywhere in the round
+
+This is strictly a debug mode — the instrumentation costs a few percent and
+is off by default. Complementing it, `assert_finite_params` is a cheap
+post-round host-side sanity check the driver can run every snap round at
+negligible cost (one all-reduce over the params).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def guard_round_fn(round_fn):
+    """Wrap a round(params, key) -> (params, info) fn with float checks.
+
+    The wrapped fn raises `checkify.JaxRuntimeError` naming the failed check
+    on the first NaN/inf produced inside the compiled round."""
+    checked = checkify.checkify(round_fn, errors=checkify.float_checks)
+
+    def wrapped(*args):
+        err, out = checked(*args)
+        checkify.check_error(err)
+        return out
+
+    return wrapped
+
+
+def assert_finite_params(params, where: str = "",
+                         raise_error: bool = True) -> bool:
+    """Host-side post-round guard: one fused reduction + one device sync.
+
+    Returns True when all params are finite. On divergence: raises when
+    `raise_error`, else prints a loud warning and returns False (so sweeps
+    record their NaN metrics instead of aborting)."""
+    finite = bool(jnp.all(jnp.stack(
+        [jnp.isfinite(l).all()
+         for l in jax.tree_util.tree_leaves(params)])))
+    if not finite:
+        msg = (f"non-finite parameters detected"
+               f"{' at ' + where if where else ''}"
+               f" — rerun with --debug_nan to locate the producing op")
+        if raise_error:
+            raise FloatingPointError(msg)
+        print(f"[guards] WARNING: {msg}")
+    return finite

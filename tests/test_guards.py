@@ -1,0 +1,54 @@
+"""Numerical-health guards (utils/guards.py, SURVEY.md section 5.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
+    assert_finite_params, guard_round_fn)
+
+
+def test_guard_raises_on_nan():
+    def bad_round(params, key):
+        return {"w": params["w"] * jnp.log(-jnp.ones(()))}, {"loss": 0.0}
+
+    guarded = guard_round_fn(bad_round)
+    with pytest.raises(checkify.JaxRuntimeError):
+        guarded({"w": jnp.ones(3)}, jax.random.PRNGKey(0))
+
+
+def test_guard_passes_clean_round():
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    cfg = Config(data="synthetic", num_agents=2, bs=16, local_ep=1,
+                 synth_train_size=64, synth_val_size=32)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    rf = make_round_fn(cfg, model, norm, jnp.asarray(fed.train.images),
+                       jnp.asarray(fed.train.labels),
+                       jnp.asarray(fed.train.sizes))
+    guarded = guard_round_fn(rf)
+    new_params, info = guarded(params, jax.random.PRNGKey(1))
+    assert np.isfinite(float(info["train_loss"]))
+
+
+def test_assert_finite_params():
+    assert assert_finite_params({"a": jnp.ones(3)})
+    with pytest.raises(FloatingPointError, match="round 7"):
+        assert_finite_params({"a": jnp.array([1.0, np.nan])},
+                             where="round 7")
+    # warn-only mode: returns False, does not raise (sweeps keep running)
+    assert not assert_finite_params({"a": jnp.array([np.inf])},
+                                    raise_error=False)
