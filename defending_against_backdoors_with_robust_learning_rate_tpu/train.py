@@ -34,7 +34,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry i
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
     checkpoint as ckpt)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
-    assert_finite_params)
+    assert_finite_params, guard_round_fn)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
     MetricsWriter, NullWriter, run_name)
 
@@ -152,8 +152,6 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     if cfg.debug_nan:
         # sanitizer mode (SURVEY.md section 5.2): float checks compiled into
         # every round variant; raises on the first NaN/inf produced
-        from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
-            guard_round_fn)
         print("[guards] checkify float checks enabled (--debug_nan)")
         if host_sampler is None:
             round_fn = guard_round_fn(round_fn)

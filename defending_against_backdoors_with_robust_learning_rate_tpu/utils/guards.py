@@ -37,16 +37,21 @@ def guard_round_fn(round_fn):
     return wrapped
 
 
+@jax.jit
+def _all_finite(params):
+    return jnp.all(jnp.stack(
+        [jnp.isfinite(l).all()
+         for l in jax.tree_util.tree_leaves(params)]))
+
+
 def assert_finite_params(params, where: str = "",
                          raise_error: bool = True) -> bool:
-    """Host-side post-round guard: one fused reduction + one device sync.
+    """Host-side post-round guard: one compiled reduction + one device sync.
 
     Returns True when all params are finite. On divergence: raises when
     `raise_error`, else prints a loud warning and returns False (so sweeps
     record their NaN metrics instead of aborting)."""
-    finite = bool(jnp.all(jnp.stack(
-        [jnp.isfinite(l).all()
-         for l in jax.tree_util.tree_leaves(params)])))
+    finite = bool(_all_finite(params))
     if not finite:
         msg = (f"non-finite parameters detected"
                f"{' at ' + where if where else ''}"
