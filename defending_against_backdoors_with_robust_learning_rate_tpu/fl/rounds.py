@@ -32,11 +32,12 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate imp
 
 
 def _pallas_applicable(cfg) -> bool:
-    """The fused Pallas server step covers the (weighted-FedAvg [+ RLR],
-    no server noise) path — the paper's headline configuration. Diagnostics
-    need the explicit lr tree, which the fused kernel never materializes."""
-    return (bool(cfg.use_pallas) and cfg.aggr == "avg" and cfg.noise == 0
-            and not cfg.diagnostics)
+    """The fused Pallas server step covers the (weighted-FedAvg or signSGD
+    [+ RLR], no server noise) paths — the paper's headline configurations.
+    Diagnostics need the explicit lr tree, which the fused kernel never
+    materializes."""
+    return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
+            and cfg.noise == 0 and not cfg.diagnostics)
 
 
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
@@ -52,7 +53,7 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         new_params = fused_rlr_avg_apply(
             params, updates, sizes.astype(jnp.float32),
             float(cfg.robustLR_threshold), cfg.effective_server_lr,
-            interpret=jax.default_backend() != "tpu")
+            interpret=jax.default_backend() != "tpu", mode=cfg.aggr)
         return new_params, jnp.mean(losses), {}
     if cfg.robustLR_threshold > 0:
         lr = robust_lr(updates, float(cfg.robustLR_threshold),

@@ -39,22 +39,31 @@ _BLOCK = 1024          # lane-dim tile (multiple of 128)
 _SUBLANE = 8           # f32 sublane multiple
 
 
-def _kernel(u_ref, wn_ref, p_ref, o_ref, *, threshold, server_lr, use_rlr):
+def _kernel(u_ref, wn_ref, p_ref, o_ref, *, threshold, server_lr, use_rlr,
+            mode):
     u = u_ref[:]                                   # [m_pad, BLOCK]
-    wavg = jnp.sum(u * wn_ref[:], axis=0)          # weighted FedAvg
+    if mode == "sign" or use_rlr:
+        ssum = jnp.sum(jnp.sign(u), axis=0)        # per-coordinate sign sum
+    if mode == "sign":
+        agg = jnp.sign(ssum)                       # signSGD majority vote
+    else:
+        agg = jnp.sum(u * wn_ref[:], axis=0)       # weighted FedAvg
     if use_rlr:
-        vote = jnp.abs(jnp.sum(jnp.sign(u), axis=0))
-        lr = jnp.where(vote >= threshold, server_lr, -server_lr)
+        lr = jnp.where(jnp.abs(ssum) >= threshold, server_lr, -server_lr)
     else:
         lr = server_lr
-    o_ref[:] = p_ref[:] + (lr * wavg)[None, :]
+    o_ref[:] = p_ref[:] + (lr * agg)[None, :]
 
 
 def fused_rlr_avg_apply_flat(params_flat, updates_flat, weights,
                              threshold: float, server_lr: float,
-                             interpret: bool = False):
+                             interpret: bool = False, mode: str = "avg"):
     """params': [n]; updates: [m, n]; weights: [m] (need not be normalized).
-    threshold <= 0 disables the RLR vote (plain server_lr FedAvg)."""
+    threshold <= 0 disables the RLR vote. mode: 'avg' (weighted FedAvg,
+    src/aggregation.py:57-64) or 'sign' (signSGD majority vote,
+    src/aggregation.py:71-75; weights unused)."""
+    if mode not in ("avg", "sign"):
+        raise ValueError(f"unsupported mode {mode!r}")
     m, n = updates_flat.shape
     m_pad = -(-m // _SUBLANE) * _SUBLANE
     n_pad = -(-n // _BLOCK) * _BLOCK
@@ -69,7 +78,7 @@ def fused_rlr_avg_apply_flat(params_flat, updates_flat, weights,
 
     kernel = functools.partial(_kernel, threshold=float(threshold),
                                server_lr=float(server_lr),
-                               use_rlr=threshold > 0)
+                               use_rlr=threshold > 0, mode=mode)
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // _BLOCK,),
@@ -87,7 +96,7 @@ def fused_rlr_avg_apply_flat(params_flat, updates_flat, weights,
 
 def fused_rlr_avg_apply(params, stacked_updates, weights,
                         threshold: float, server_lr: float,
-                        interpret: bool = False):
+                        interpret: bool = False, mode: str = "avg"):
     """Pytree wrapper: ravel -> fused kernel -> unravel."""
     from jax.flatten_util import ravel_pytree
 
@@ -97,5 +106,5 @@ def fused_rlr_avg_apply(params, stacked_updates, weights,
         tree_ops.map(lambda x: x[i], stacked_updates))[0])(jnp.arange(m))
     new_flat = fused_rlr_avg_apply_flat(flat_p, flat_u, weights,
                                         threshold, server_lr,
-                                        interpret=interpret)
+                                        interpret=interpret, mode=mode)
     return unravel(new_flat)

@@ -176,9 +176,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                         "the explicit lr vector)")
             print(msg)
         else:
-            print(f"[pallas] fused kernel covers aggr=avg with noise=0; "
-                  f"aggr={cfg.aggr!r} noise={cfg.noise} falls back to the "
-                  f"jnp path")
+            print(f"[pallas] fused kernel covers aggr=avg/sign with "
+                  f"noise=0; aggr={cfg.aggr!r} noise={cfg.noise} falls back "
+                  f"to the jnp path")
 
     eval_fn = make_eval_fn(model, norm, cfg.n_classes)
     fisher_fn = None

@@ -56,6 +56,57 @@ def test_fused_tree_matches_jnp_path():
                                    atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("thr", [0.0, 3.0])
+def test_fused_sign_mode_matches_reference(thr):
+    """mode='sign': p' = p + lr * sign(sum_i sign(u_i)) (signSGD majority,
+    src/aggregation.py:71-75), with the RLR vote sharing the sign sums."""
+    rng = np.random.default_rng(2)
+    m, n = 6, 2222
+    u = rng.normal(size=(m, n)).astype(np.float32)
+    w = rng.uniform(1, 5, size=(m,)).astype(np.float32)  # unused in sign
+    p = rng.normal(size=(n,)).astype(np.float32)
+    slr = 0.05   # sign keeps the true server_lr (src/federated.py:23)
+
+    got = np.asarray(fused_rlr_avg_apply_flat(
+        jnp.asarray(p), jnp.asarray(u), jnp.asarray(w), thr, slr,
+        interpret=True, mode="sign"))
+
+    ssum = np.sign(u).sum(0)
+    agg = np.sign(ssum)
+    lr = np.where(np.abs(ssum) >= thr, slr, -slr) if thr > 0 else slr
+    np.testing.assert_allclose(got, p + lr * agg, atol=1e-6, rtol=1e-6)
+
+
+def test_fused_sign_round_matches_jnp_round():
+    """Full round with aggr='sign' + RLR: --use_pallas == jnp path."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    cfg = Config(data="synthetic", num_agents=4, bs=16, local_ep=1,
+                 synth_train_size=128, synth_val_size=32, aggr="sign",
+                 server_lr=0.01, robustLR_threshold=3, seed=5)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+    key = jax.random.PRNGKey(3)
+    p_jnp, _ = make_round_fn(cfg, model, norm, *arrays)(params, key)
+    p_pl, _ = make_round_fn(cfg.replace(use_pallas=True), model, norm,
+                            *arrays)(params, key)
+    for a, b in zip(jax.tree_util.tree_leaves(p_jnp),
+                    jax.tree_util.tree_leaves(p_pl)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_round_with_pallas_matches_default():
     """Full round: --use_pallas output == jnp path output."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
