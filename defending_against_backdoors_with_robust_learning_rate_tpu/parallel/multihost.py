@@ -72,9 +72,15 @@ def global_agents_mesh(n_devices: int = 0) -> Mesh:
             f"n_devices={n_devices}; pick num_agents/agent_frac so the "
             f"per-round participant count is divisible by {total}")
     from jax.experimental import mesh_utils
+    # process_is_granule=True: one DCN granule per *process*. The default
+    # granule is the slice, and on any slice spanning multiple hosts
+    # (v5e-16 .. v5e-256) slice_count != process_count, which would make
+    # this construction raise. Per-process granules are valid on every
+    # topology and still order ICI neighbors contiguously within a host.
     devices = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(jax.local_device_count(),),
-        dcn_mesh_shape=(jax.process_count(),)).reshape(-1)
+        dcn_mesh_shape=(jax.process_count(),),
+        process_is_granule=True).reshape(-1)
     return Mesh(devices, (AGENTS_AXIS,))
 
 

@@ -205,6 +205,30 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
                                      images, labels, sizes))
 
 
+def make_sharded_round_fn_host(cfg, model, normalize, mesh):
+    """Host-sampled sharded round fn: round(params, key, imgs, lbls, sizes).
+
+    The fedemnist-scale path (3383 users, ref runner.sh:34-38): the full
+    agent stack exceeds the device-resident budget, so the driver gathers the
+    round's m sampled shards host-side and THIS fn partitions them over the
+    `agents` mesh (m/d per device) before the shard_mapped body runs. Key
+    derivation (split into k_train/k_noise, then m agent keys) matches
+    fl/rounds.make_round_fn_host bit-for-bit, so the sharded and
+    single-device host paths are comparable round-for-round."""
+    sharded = _build_sharded_body(cfg, model, normalize, mesh)
+    m = cfg.agents_per_round
+
+    @jax.jit
+    def round_fn(params, key, imgs, lbls, szs):
+        k_train, k_noise = jax.random.split(key)
+        agent_keys = jax.random.split(k_train, m)
+        new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
+                                                 agent_keys, k_noise)
+        return new_params, {"train_loss": train_loss, **extras}
+
+    return round_fn
+
+
 def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
                                   images, labels, sizes):
     """Chained sharded rounds: chained(params, base_key, round_ids).

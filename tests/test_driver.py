@@ -1,0 +1,53 @@
+"""Driver-level tests of train.run: the round loop, eval, and the
+host-sampled + mesh path added in round 2 (VERDICT r1 #5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu import train
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+    NullWriter)
+
+BASE = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
+              synth_train_size=256, synth_val_size=64, eval_bs=64,
+              rounds=4, snap=2, seed=5, tensorboard=False)
+
+
+def _run(cfg):
+    return train.run(cfg, writer=NullWriter())
+
+
+def test_driver_device_resident():
+    summary = _run(BASE)
+    assert summary["round"] == 4
+    assert np.isfinite(summary["val_acc"])
+    assert 0.0 <= summary["val_acc"] <= 1.0
+    assert 0.0 <= summary["poison_acc"] <= 1.0
+
+
+def test_driver_host_mode_single_device(monkeypatch):
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    summary = _run(BASE)
+    assert summary["round"] == 4 and np.isfinite(summary["val_acc"])
+
+
+def test_driver_host_mode_sharded_matches_single(monkeypatch, capsys):
+    """--data=fedemnist-scale + --mesh>1: host-gathered shards partitioned
+    over the agents mesh must reproduce the single-device host path."""
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    s1 = _run(BASE)
+    s2 = _run(BASE.replace(mesh=0))   # 0 = all (8 faked CPU) devices
+    # guard against vacuous parity: the second run must actually shard
+    assert "host-sampled shards" in capsys.readouterr().out
+    assert s2["round"] == s1["round"]
+    np.testing.assert_allclose(s2["val_acc"], s1["val_acc"], atol=1e-4)
+    np.testing.assert_allclose(s2["val_loss"], s1["val_loss"],
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_driver_mesh_device_resident_with_rlr():
+    summary = _run(BASE.replace(mesh=0, num_corrupt=2, poison_frac=1.0,
+                                robustLR_threshold=4))
+    assert summary["round"] == 4 and np.isfinite(summary["val_acc"])

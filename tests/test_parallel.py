@@ -126,3 +126,62 @@ def test_sharded_multiround_trains():
         params, info = sharded(params, sub)
         losses.append(float(info["train_loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_sharded_host_round_matches_single_device_host():
+    """Host-sampled sharded path (fedemnist-scale, VERDICT r1 #5): the
+    shard_mapped round over host-gathered [m, ...] stacks must match the
+    single-device host round bit-for-bit in sampling and closely in params."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn_host)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+        AGENTS_AXIS)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+        make_sharded_round_fn_host)
+
+    cfg, model, params, norm, arrays = _setup("avg")
+    images, labels, sizes = arrays
+    # the driver gathers m sampled shards host-side; emulate with a fixed
+    # id set (m = agents_per_round = num_agents = 8 here)
+    ids = np.array([3, 1, 7, 2, 5, 0, 6, 4])
+    gathered = (images[ids], labels[ids], sizes[ids])
+    key = jax.random.PRNGKey(9)
+
+    single = make_round_fn_host(cfg, model, norm)
+    p1, info1 = single(params, key, *gathered)
+
+    mesh = make_mesh(8)
+    sharding = NamedSharding(mesh, P(AGENTS_AXIS))
+    sharded = make_sharded_round_fn_host(cfg, model, norm, mesh)
+    p2, info2 = sharded(params, key,
+                        *(jax.device_put(a, sharding) for a in gathered))
+
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(info1["train_loss"]),
+                               float(info2["train_loss"]), rtol=1e-4)
+
+
+def test_guarded_sharded_round_runs():
+    """--debug_nan over the shard_mapped path (ADVICE r1): checkify must
+    accept the psum/all_to_all/all_gather collectives at trace time and the
+    guarded fn must still raise on an injected NaN."""
+    import pytest
+    from jax.experimental import checkify
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
+        guard_round_fn)
+
+    cfg, model, params, norm, arrays = _setup("comed")
+    mesh = make_mesh(8)
+    sharded = make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
+    guarded = guard_round_fn(sharded)
+    p, info = guarded(params, jax.random.PRNGKey(3))
+    assert np.isfinite(float(info["train_loss"]))
+
+    bad = jax.tree_util.tree_map(lambda l: l.at[...].set(jnp.nan)
+                                 if l.ndim else l, params)
+    with pytest.raises(checkify.JaxRuntimeError):
+        guarded(bad, jax.random.PRNGKey(4))
