@@ -9,28 +9,90 @@ same 60k x 28x28 geometry.
 
 Prints ONE JSON line:
   {"metric": "fl_rounds_per_sec", "value": N, "unit": "rounds/sec",
-   "vs_baseline": N}
+   "vs_baseline": N, ...}
 
-vs_baseline is the speedup over the reference-semantics torch loop measured
-on this host (BASELINE_MEASURED.json, scripts/measure_reference_baseline.py):
-the reference trains sampled agents sequentially (src/federated.py:68-72), so
+value is STEADY-STATE rounds/sec (post-compile); `compile_s` records the
+first-block compile separately (VERDICT r1 #9). vs_baseline is the speedup
+over the reference-semantics torch loop measured on this host
+(BASELINE_MEASURED.json, scripts/measure_reference_baseline.py): the
+reference trains sampled agents sequentially (src/federated.py:68-72), so
 its round time is agents * local_ep * batches * sec_per_batch_step.
+
+Wedge-safety (VERDICT r1 #2): the TPU backend behind this machine's tunnel
+can hang indefinitely (even `jax.devices()`) after a killed process. The
+backend is therefore probed in a BOUNDED SUBPROCESS first; on probe failure
+the benchmark falls back to CPU and says so in the JSON (`device`,
+`backend_note`) instead of hanging or stack-tracing into the driver's
+capture. The main process itself never wraps TPU work in a watchdog that
+could kill mid-compile — that is what wedges the chip.
 """
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def probe_backend(timeout_s: float) -> str | None:
+    """Return the default backend name, probed in a bounded subprocess.
+
+    None means the backend never came up within the budget (wedged tunnel /
+    missing hardware). Only the *probe* child is ever killed — it does no
+    compilation, so killing it cannot wedge a healthy chip mid-compile."""
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("BACKEND="):
+            return line.split("=", 1)[1]
+    return None
+
+
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (skips the probe)")
+    ap.add_argument("--chain", type=int, default=10,
+                    help="rounds fused per lax.scan block")
+    ap.add_argument("--blocks", type=int, default=3,
+                    help="timed steady-state blocks")
+    ap.add_argument("--dtype", default="",
+                    help="override compute dtype (e.g. bfloat16)")
+    ap.add_argument("--use_pallas", action="store_true",
+                    help="fused Pallas RLR+FedAvg server step")
+    ap.add_argument("--probe_timeout", type=float, default=90.0)
+    args = ap.parse_args()
+
+    import jax
+
+    backend_note = ""
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    else:
+        probed = probe_backend(args.probe_timeout)
+        if probed is None:
+            backend_note = (f"default backend unreachable within "
+                            f"{args.probe_timeout:.0f}s (wedged TPU "
+                            f"tunnel?); CPU fallback")
+            log(f"[bench] WARNING: {backend_note}")
+            jax.config.update("jax_platforms", "cpu")
+        else:
+            log(f"[bench] probed backend: {probed}")
+
+    import jax.numpy as jnp
+
     from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
     from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
         get_federated_data)
@@ -43,7 +105,10 @@ def main():
 
     cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
                  num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
-                 synth_train_size=60000, synth_val_size=10000, seed=0)
+                 synth_train_size=60000, synth_val_size=10000, seed=0,
+                 use_pallas=args.use_pallas,
+                 **({"dtype": args.dtype} if args.dtype else {}))
+    device = jax.devices()[0]
     log(f"[bench] devices: {jax.devices()}")
 
     fed = get_federated_data(cfg)
@@ -53,7 +118,7 @@ def main():
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
     # chained execution: blocks of rounds fused into one lax.scan dispatch
     # (bit-identical to per-round dispatch; see fl/rounds.py)
-    chain = 10
+    chain = args.chain
     chained = make_chained_round_fn(cfg, model, norm,
                                     jnp.asarray(fed.train.images),
                                     jnp.asarray(fed.train.labels),
@@ -64,20 +129,19 @@ def main():
     t0 = time.perf_counter()
     params, _ = chained(params, base_key, jnp.arange(1, chain + 1))
     jax.block_until_ready(params)
-    log(f"[bench] compile+first {chain}-round block: "
-        f"{time.perf_counter() - t0:.1f}s")
+    compile_s = time.perf_counter() - t0
+    log(f"[bench] compile+first {chain}-round block: {compile_s:.1f}s")
 
-    n_blocks = 3
-    n_rounds = n_blocks * chain
+    n_rounds = args.blocks * chain
     t0 = time.perf_counter()
-    for b in range(n_blocks):
+    for b in range(args.blocks):
         ids = jnp.arange((b + 1) * chain + 1, (b + 2) * chain + 1)
         params, _ = chained(params, base_key, ids)
     jax.block_until_ready(params)
     elapsed = time.perf_counter() - t0
     rounds_per_sec = n_rounds / elapsed
     log(f"[bench] {n_rounds} rounds in {elapsed:.2f}s "
-        f"-> {rounds_per_sec:.3f} rounds/sec")
+        f"-> {rounds_per_sec:.3f} rounds/sec steady-state")
 
     vs_baseline = 1.0
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -93,10 +157,16 @@ def main():
             f"{ref_round_sec:.1f}s on this host's CPU -> "
             f"speedup {vs_baseline:.1f}x")
 
-    print(json.dumps({"metric": "fl_rounds_per_sec",
-                      "value": round(rounds_per_sec, 4),
-                      "unit": "rounds/sec",
-                      "vs_baseline": round(vs_baseline, 2)}))
+    out = {"metric": "fl_rounds_per_sec",
+           "value": round(rounds_per_sec, 4),
+           "unit": "rounds/sec",
+           "vs_baseline": round(vs_baseline, 2),
+           "compile_s": round(compile_s, 1),
+           "chain": chain,
+           "device": str(device)}
+    if backend_note:
+        out["backend_note"] = backend_note
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

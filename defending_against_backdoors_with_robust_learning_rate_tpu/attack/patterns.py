@@ -68,9 +68,23 @@ def _plus_mask(h: int, w: int, start: int, size: int,
     return m
 
 
+def _asset_search_path(data_dir: str):
+    """Where the watermark/apple PNGs are looked for, in order: the data
+    dir and its parent (the reference loads `../watermark.png` relative to
+    src/, utils.py:233), an `assets/` dir next to the package, the
+    `RLR_ASSET_DIR` env var, and a reference checkout at /root/reference
+    (this build machine). The assets are MIT-licensed images from the
+    reference repo; drop them in any of these to get pixel-parity stamps."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return (data_dir, ".", os.path.dirname(data_dir or "."),
+            os.path.join(os.path.dirname(here), "assets"),
+            os.environ.get("RLR_ASSET_DIR", ""),
+            "/root/reference")
+
+
 def _load_watermark(name: str, data_dir: str) -> Optional[np.ndarray]:
     """cv2-load + invert + resize to 28x28, as utils.py:233-241."""
-    for base in (data_dir, ".", os.path.dirname(data_dir or ".")):
+    for base in _asset_search_path(data_dir):
         path = os.path.join(base or ".", name)
         if os.path.exists(path):
             try:

@@ -78,6 +78,11 @@ class Config:
     # synthetic-data knobs (used when `data` is missing on disk or 'synthetic')
     synth_train_size: int = 2048
     synth_val_size: int = 512
+    synth_hardness: float = 0.0     # 0 = easy separable prototypes; >0 mixes
+                                    # a shared background into the prototypes,
+                                    # raises pixel noise and adds label noise
+                                    # so val_acc climbs over tens of rounds
+                                    # instead of saturating immediately
 
     @property
     def effective_server_lr(self) -> float:
@@ -199,6 +204,11 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no_tensorboard", action="store_true")
     p.add_argument("--synth_train_size", type=int, default=d.synth_train_size)
     p.add_argument("--synth_val_size", type=int, default=d.synth_val_size)
+    p.add_argument("--synth_hardness", type=float, default=d.synth_hardness,
+                   help="0=easy separable synthetic task; 0..1 mixes "
+                        "prototypes toward a shared background, raises pixel "
+                        "noise and adds label noise (learning curves become "
+                        "non-trivial)")
 
 
 def args_parser(argv: Optional[list] = None) -> Config:

@@ -183,26 +183,47 @@ def _load_fedemnist(data_dir: str):
 
 def make_synthetic(name: str, shape: Tuple[int, int, int], n_train: int,
                    n_val: int, seed: int, n_classes: int = 10,
-                   float_normalized: bool = False
+                   float_normalized: bool = False, hardness: float = 0.0
                    ) -> Tuple[RawDataset, RawDataset]:
     """Deterministic class-structured data: each class is a fixed random
     prototype image plus pixel noise — linearly separable, so a small CNN
-    learns it in a few steps and backdoor dynamics are observable."""
+    learns it in a few steps and backdoor dynamics are observable.
+
+    `hardness` in [0, 1] controls task difficulty (VERDICT r1 #4: at 0 the
+    task saturates val_acc=1.0 within ~20 rounds, which makes accuracy
+    curves vacuous). At hardness h:
+      - each prototype is pulled toward a single shared background image
+        (class signal shrinks by 1-0.85h — classes overlap),
+      - pixel noise grows from sigma=0.10 to 0.10+0.35h (SNR drops),
+      - a fraction 0.1h of TRAIN labels is resampled uniformly (irreducible
+        label noise; validation stays clean so val_acc is interpretable).
+    hardness=0 reproduces the round-1 data bit-for-bit."""
     rng = np.random.default_rng(seed)
     h, w, c = shape
     protos = rng.uniform(0.15, 0.85, size=(n_classes, h, w, c))
+    if hardness > 0.0:
+        shared = rng.uniform(0.15, 0.85, size=(h, w, c))
+        mix = 0.85 * float(hardness)
+        protos = (1.0 - mix) * protos + mix * shared
+    sigma = 0.10 + 0.35 * float(hardness)
+    label_noise = 0.1 * float(hardness)
 
-    def gen(n, split_seed):
+    def gen(n, split_seed, noisy_labels):
         r = np.random.default_rng(seed * 1000003 + split_seed)
         labels = r.integers(0, n_classes, size=n).astype(np.int32)
-        noise = r.normal(0.0, 0.10, size=(n, h, w, c))
+        noise = r.normal(0.0, sigma, size=(n, h, w, c))
         x = np.clip(protos[labels] + noise, 0.0, 1.0)
+        if noisy_labels and label_noise > 0.0:
+            flip = r.random(n) < label_noise
+            labels = np.where(
+                flip, r.integers(0, n_classes, size=n).astype(np.int32),
+                labels)
         if float_normalized:
             return x.astype(np.float32), labels
         return (x * 255.0).astype(np.uint8), labels
 
-    tx, ty = gen(n_train, 1)
-    vx, vy = gen(n_val, 2)
+    tx, ty = gen(n_train, 1, True)
+    vx, vy = gen(n_val, 2, False)
     return RawDataset(tx, ty, name), RawDataset(vx, vy, name)
 
 
@@ -219,14 +240,16 @@ def get_datasets(cfg) -> Tuple[object, RawDataset, bool]:
         if got is not None:
             return got[0], got[1], False
         tr, va = make_synthetic("fmnist", (28, 28, 1), cfg.synth_train_size,
-                                cfg.synth_val_size, cfg.seed)
+                                cfg.synth_val_size, cfg.seed,
+                                hardness=cfg.synth_hardness)
         return tr, va, True
     if cfg.data == "cifar10":
         got = _load_cifar10(cfg.data_dir)
         if got is not None:
             return got[0], got[1], False
         tr, va = make_synthetic("cifar10", (32, 32, 3), cfg.synth_train_size,
-                                cfg.synth_val_size, cfg.seed)
+                                cfg.synth_val_size, cfg.seed,
+                                hardness=cfg.synth_hardness)
         return tr, va, True
     if cfg.data == "fedemnist":
         got = _load_fedemnist(cfg.data_dir)
@@ -243,7 +266,8 @@ def get_datasets(cfg) -> Tuple[object, RawDataset, bool]:
         rng = np.random.default_rng(cfg.seed + 7)
         tr, va = make_synthetic("fedemnist", (28, 28, 1),
                                 cfg.synth_train_size, cfg.synth_val_size,
-                                cfg.seed, float_normalized=True)
+                                cfg.seed, float_normalized=True,
+                                hardness=cfg.synth_hardness)
         sizes = rng.integers(max(8, cfg.bs // 4),
                              max(16, cfg.bs), size=cfg.num_agents)
         order = rng.permutation(len(tr.images))
@@ -258,7 +282,7 @@ def get_datasets(cfg) -> Tuple[object, RawDataset, bool]:
     if cfg.data == "synthetic":
         tr, va = make_synthetic("synthetic", cfg.image_shape,
                                 cfg.synth_train_size, cfg.synth_val_size,
-                                cfg.seed)
+                                cfg.seed, hardness=cfg.synth_hardness)
         return tr, va, True
     raise ValueError(f"unknown dataset {cfg.data!r}")
 

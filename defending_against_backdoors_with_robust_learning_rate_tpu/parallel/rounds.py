@@ -126,8 +126,42 @@ def _sharded_robust_lr(updates, cfg):
     return tree.map(leaf, updates)
 
 
+def _sharded_pallas_apply(params, updates, sizes, cfg):
+    """Fused server step over the mesh: ONE Pallas pass per device over the
+    local [m/d, n] update block (partial sign-sum + partial weighted sum),
+    psum of the two n-vectors, then an elementwise lr/apply that XLA fuses.
+    HBM reads U exactly once per device — the single-device kernel's
+    property (ops/pallas_rlr.py), composed with ICI collectives."""
+    from jax.flatten_util import ravel_pytree
+    from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
+        partial_vote_avg_flat)
+
+    flat_p, unravel = ravel_pytree(params)
+    mb = jax.tree_util.tree_leaves(updates)[0].shape[0]
+    flat_u = jax.vmap(lambda i: ravel_pytree(
+        tree.map(lambda x: x[i], updates))[0])(jnp.arange(mb))
+    w = sizes.astype(jnp.float32)
+    total = jax.lax.psum(jnp.sum(w), AGENTS_AXIS)
+    ssum, wsum = partial_vote_avg_flat(
+        flat_u, w / total, interpret=jax.default_backend() != "tpu")
+    ssum = jax.lax.psum(ssum, AGENTS_AXIS)
+    if cfg.aggr == "sign":
+        agg = jnp.sign(ssum)
+    else:
+        agg = jax.lax.psum(wsum, AGENTS_AXIS)
+    slr = cfg.effective_server_lr
+    if cfg.robustLR_threshold > 0:
+        lr = jnp.where(jnp.abs(ssum) >= float(cfg.robustLR_threshold),
+                       slr, -slr)
+    else:
+        lr = slr
+    return unravel(flat_p + lr * agg)
+
+
 def _build_sharded_body(cfg, model, normalize, mesh):
     """The shard_mapped round body shared by the per-round and chained fns."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        _pallas_applicable)
     local_train = make_local_train(model, cfg, normalize)
     m = cfg.agents_per_round
     d = mesh.devices.size
@@ -136,6 +170,10 @@ def _build_sharded_body(cfg, model, normalize, mesh):
     def shard_body(params, imgs, lbls, szs, keys, noise_key):
         updates, losses = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
             params, imgs, lbls, szs, keys)
+        if _pallas_applicable(cfg):
+            new_params = _sharded_pallas_apply(params, updates, szs, cfg)
+            loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
+            return new_params, loss, {}
         if cfg.robustLR_threshold > 0:
             lr = _sharded_robust_lr(updates, cfg)
         else:

@@ -206,10 +206,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     if cfg.use_pallas:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
             _pallas_applicable)
-        if n_mesh > 1:
-            print("[pallas] the sharded mesh path aggregates with XLA "
-                  "collectives; the fused kernel applies to the "
-                  "single-device path only — --use_pallas ignored")
+        if n_mesh > 1 and _pallas_applicable(plain_cfg):
+            print("[pallas] sharded fused server step: one Pallas pass per "
+                  "device + psum of the sign/avg partials")
         elif _pallas_applicable(plain_cfg):
             msg = "[pallas] fused RLR+FedAvg+apply server kernel enabled"
             if cfg.diagnostics:

@@ -90,3 +90,29 @@ def test_fmnist_watermark_uint8_wraparound():
     if hot.any():
         i, j = np.argwhere(hot)[0]
         assert out[i, j, 0] == (200 + int(s.value[i, j])) % 256
+
+
+def test_real_watermark_assets_pixel_parity():
+    """With the reference's MIT-licensed PNG assets on the search path, the
+    stamp must equal the reference cv2 pipeline exactly: imread grayscale ->
+    bitwise_not -> INTER_CUBIC resize to 28x28 (utils.py:233-241)."""
+    import os
+    import pytest
+    cv2 = pytest.importorskip("cv2")
+    asset_dir = os.environ.get("RLR_ASSET_DIR", "/root/reference")
+    for ptype, fname in (("copyright", "watermark.png"),
+                         ("apple", "apple.png")):
+        path = os.path.join(asset_dir, fname)
+        if not os.path.exists(path):
+            pytest.skip(f"asset {fname} not available")
+        expect = cv2.resize(
+            cv2.bitwise_not(cv2.imread(path, cv2.IMREAD_GRAYSCALE)),
+            dsize=(28, 28), interpolation=cv2.INTER_CUBIC).astype(np.float32)
+
+        s = build_stamp("fmnist", ptype, data_dir="/nonexistent")
+        np.testing.assert_array_equal(s.value, expect)
+        assert s.mode == "addu8"
+
+        s_fed = build_stamp("fedemnist", ptype, data_dir="/nonexistent")
+        np.testing.assert_allclose(s_fed.value, expect / 255.0)
+        assert s_fed.mode == "subf"
