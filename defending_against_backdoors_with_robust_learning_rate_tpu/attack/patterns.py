@@ -69,17 +69,17 @@ def _plus_mask(h: int, w: int, start: int, size: int,
 
 
 def _asset_search_path(data_dir: str):
-    """Where the watermark/apple PNGs are looked for, in order: the data
-    dir and its parent (the reference loads `../watermark.png` relative to
-    src/, utils.py:233), an `assets/` dir next to the package, the
-    `RLR_ASSET_DIR` env var, and a reference checkout at /root/reference
-    (this build machine). The assets are MIT-licensed images from the
-    reference repo; drop them in any of these to get pixel-parity stamps."""
+    """Where the watermark/apple PNGs are looked for, in order: the
+    `RLR_ASSET_DIR` env var, the data dir and its parent (the reference
+    loads `../watermark.png` relative to src/, utils.py:233), and an
+    `assets/` dir next to the package. The assets are MIT-licensed images
+    from the reference repo; drop them in any of these (or point
+    RLR_ASSET_DIR at a checkout) to get pixel-parity stamps."""
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return (data_dir, ".", os.path.dirname(data_dir or "."),
-            os.path.join(os.path.dirname(here), "assets"),
-            os.environ.get("RLR_ASSET_DIR", ""),
-            "/root/reference")
+    env = os.environ.get("RLR_ASSET_DIR")
+    return tuple(p for p in (
+        env, data_dir, ".", os.path.dirname(data_dir or "."),
+        os.path.join(os.path.dirname(here), "assets")) if p)
 
 
 def _load_watermark(name: str, data_dir: str) -> Optional[np.ndarray]:

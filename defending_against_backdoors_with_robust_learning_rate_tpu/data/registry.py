@@ -192,11 +192,20 @@ def make_synthetic(name: str, shape: Tuple[int, int, int], n_train: int,
     `hardness` in [0, 1] controls task difficulty (VERDICT r1 #4: at 0 the
     task saturates val_acc=1.0 within ~20 rounds, which makes accuracy
     curves vacuous). At hardness h:
+      - each sample's prototype is circularly shifted by a per-sample
+        random offset up to round(6h) pixels per axis — template matching
+        stops working and the CNN has to learn shift-tolerant features,
+        which is what makes accuracy climb over tens of rounds instead of
+        a few steps (a fixed template is linearly separable at any noise
+        level, so noise alone cannot slow learning down),
       - each prototype is pulled toward a single shared background image
         (class signal shrinks by 1-0.85h — classes overlap),
       - pixel noise grows from sigma=0.10 to 0.10+0.35h (SNR drops),
       - a fraction 0.1h of TRAIN labels is resampled uniformly (irreducible
         label noise; validation stays clean so val_acc is interpretable).
+    The trojan patterns are stamped AFTER generation on raw pixels
+    (attack/poison.py), so the trigger stays at its fixed location — shifts
+    make the task harder without touching the backdoor geometry.
     hardness=0 reproduces the round-1 data bit-for-bit."""
     rng = np.random.default_rng(seed)
     h, w, c = shape
@@ -207,12 +216,21 @@ def make_synthetic(name: str, shape: Tuple[int, int, int], n_train: int,
         protos = (1.0 - mix) * protos + mix * shared
     sigma = 0.10 + 0.35 * float(hardness)
     label_noise = 0.1 * float(hardness)
+    max_shift = int(round(6.0 * float(hardness)))
 
     def gen(n, split_seed, noisy_labels):
         r = np.random.default_rng(seed * 1000003 + split_seed)
         labels = r.integers(0, n_classes, size=n).astype(np.int32)
+        x = protos[labels]
+        if max_shift > 0:
+            dy = r.integers(-max_shift, max_shift + 1, size=n)
+            dx = r.integers(-max_shift, max_shift + 1, size=n)
+            ry = (np.arange(h)[None, :] - dy[:, None]) % h        # [n, h]
+            rx = (np.arange(w)[None, :] - dx[:, None]) % w        # [n, w]
+            x = x[np.arange(n)[:, None, None],
+                  ry[:, :, None], rx[:, None, :]]                 # [n,h,w,c]
         noise = r.normal(0.0, sigma, size=(n, h, w, c))
-        x = np.clip(protos[labels] + noise, 0.0, 1.0)
+        x = np.clip(x + noise, 0.0, 1.0)
         if noisy_labels and label_noise > 0.0:
             flip = r.random(n) < label_noise
             labels = np.where(

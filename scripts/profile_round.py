@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Component-level timing of one FL round on the bench config.
+
+Answers "where does round time go" (VERDICT r1 #2) with direct measurement
+instead of a trace viewer: times the full round fn, the vmapped local-train
+sweep alone, the server step (aggregate+RLR+apply) alone, the eval fn, and a
+forward-only variant of the client loss to split fwd vs bwd cost.
+
+Usage: python scripts/profile_round.py [--platform cpu]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def timed(fn, *args, reps=5, warmup=1):
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
+        make_local_train)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer, masked_ce)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
+        make_eval_fn, pad_eval_set)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+        aggregate_updates, apply_aggregate, robust_lr)
+
+    cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
+                 num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
+                 synth_train_size=60000, synth_val_size=10000, seed=0)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, fed.train.images.shape[2:],
+                         jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    imgs = jnp.asarray(fed.train.images)
+    lbls = jnp.asarray(fed.train.labels)
+    szs = jnp.asarray(fed.train.sizes)
+    key = jax.random.PRNGKey(1)
+
+    print(f"[profile] device={jax.devices()[0].device_kind} "
+          f"({jax.default_backend()})", flush=True)
+
+    # 1. full round
+    round_fn = make_round_fn(cfg, model, norm, imgs, lbls, szs)
+    t_round = timed(round_fn, params, key)
+    print(f"full round:            {t_round*1e3:8.1f} ms", flush=True)
+
+    # 2. local training sweep alone (all agents, vmapped — no aggregation)
+    local = make_local_train(model, cfg, norm)
+    m = cfg.agents_per_round
+    keys = jax.random.split(key, m)
+
+    @jax.jit
+    def sweep(params, keys):
+        return jax.vmap(local, in_axes=(None, 0, 0, 0, 0))(
+            params, imgs[:m], lbls[:m], szs[:m], keys)
+
+    t_sweep = timed(sweep, params, keys)
+    print(f"local-train sweep:     {t_sweep*1e3:8.1f} ms "
+          f"({100*t_sweep/t_round:.0f}% of round)", flush=True)
+
+    # 3. server step alone (RLR vote + weighted avg + apply) on real updates
+    updates, _ = sweep(params, keys)
+    updates = jax.block_until_ready(updates)
+
+    @jax.jit
+    def server(params, updates, szs, key):
+        lr = robust_lr(updates, cfg.robustLR_threshold,
+                       cfg.effective_server_lr)
+        agg = aggregate_updates(updates, szs[:m], cfg, key)
+        return apply_aggregate(params, lr, agg)
+
+    t_server = timed(server, params, updates, szs, key)
+    print(f"server step:           {t_server*1e3:8.1f} ms "
+          f"({100*t_server/t_round:.0f}% of round)", flush=True)
+
+    # 4. eval pass (val set, batched scan)
+    eval_fn = make_eval_fn(model, norm, cfg.n_classes)
+    val = tuple(map(jnp.asarray, pad_eval_set(
+        fed.val_images, fed.val_labels, cfg.eval_bs)))
+    t_eval = timed(eval_fn, params, *val)
+    print(f"eval (10k val):        {t_eval*1e3:8.1f} ms "
+          f"(runs every snap={cfg.snap} rounds)", flush=True)
+
+    # 5. fwd vs fwd+bwd on one batch shape [m*bs, ...] (the effective
+    # per-scan-step tensor after vmap)
+    x = jnp.zeros((m * cfg.bs,) + fed.train.images.shape[2:], jnp.float32)
+    y = jnp.zeros((m * cfg.bs,), jnp.int32)
+    w = jnp.ones((m * cfg.bs,), bool)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, norm(x), train=True,
+                             rngs={"dropout": jax.random.PRNGKey(0)})
+        return masked_ce(logits, y, w)
+
+    fwd = jax.jit(loss_fn)
+    fwdbwd = jax.jit(jax.value_and_grad(loss_fn))
+    t_fwd = timed(fwd, params)
+    t_fb = timed(fwdbwd, params)
+    n_steps = cfg.local_ep * (imgs.shape[1] // cfg.bs)
+    print(f"one eff-batch[{m*cfg.bs}] fwd:     {t_fwd*1e3:8.1f} ms",
+          flush=True)
+    print(f"one eff-batch[{m*cfg.bs}] fwd+bwd: {t_fb*1e3:8.1f} ms "
+          f"(x {n_steps} steps/round = {t_fb*n_steps*1e3:.0f} ms)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

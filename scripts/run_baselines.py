@@ -60,6 +60,7 @@ def run_cfg(name, cfg, snap_rounds):
     dev = jax.devices()[0]
     return {"name": name, "summary": summary, "milestones": milestones,
             "wall_s": round(wall, 1),
+            "hardness": cfg.synth_hardness,
             "device": f"{dev.device_kind} ({dev.platform})"}
 
 
@@ -75,6 +76,10 @@ def main():
     ap.add_argument("--regen", action="store_true",
                     help="rewrite RESULTS.md from the existing results.json "
                          "without running anything (no backend touched)")
+    ap.add_argument("--hardness", type=float, default=0.5,
+                    help="synth_hardness for every config (VERDICT r1 #4: "
+                         "at 0 the task saturates val_acc=1.0 by round 20 "
+                         "and the curves are vacuous)")
     args = ap.parse_args()
 
     from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
@@ -86,6 +91,7 @@ def main():
     chain = 10
     common = dict(rounds=R, snap=snap, chain=chain, seed=0,
                   synth_train_size=train_n, synth_val_size=val_n,
+                  synth_hardness=args.hardness,
                   tensorboard=False, data_dir="./data")
 
     # reference src/runner.sh:12-18 fmnist triple (10 agents, local_ep=2,
@@ -104,6 +110,7 @@ def main():
         cf = dict(data="cifar10", num_agents=40, local_ep=2, bs=256,
                   rounds=min(R, 100), snap=snap, chain=chain, seed=0,
                   synth_train_size=50000, synth_val_size=10000,
+                  synth_hardness=args.hardness,
                   tensorboard=False, data_dir="./data")
         configs += [
             ("cifar10-dba-attack", Config(num_corrupt=4, poison_frac=0.5,
@@ -111,6 +118,14 @@ def main():
             ("cifar10-dba-rlr", Config(num_corrupt=4, poison_frac=0.5,
                                        pattern_type="plus",
                                        robustLR_threshold=8, **cf)),
+            # BASELINE.json configs[3-4]: same DBA shapes on ResNet-9
+            # (VERDICT r1 #7 — the bigger model had never been run)
+            ("cifar10-resnet9-dba-attack",
+             Config(num_corrupt=4, poison_frac=0.5, pattern_type="plus",
+                    arch="resnet9", **cf)),
+            ("cifar10-resnet9-dba-rlr",
+             Config(num_corrupt=4, poison_frac=0.5, pattern_type="plus",
+                    arch="resnet9", robustLR_threshold=8, **cf)),
         ]
         # fedemnist-shaped non-IID: many agents, partial sampling, deep
         # local training (reference src/runner.sh:34-38: local_ep=10, 10%
@@ -118,8 +133,8 @@ def main():
         fe = dict(data="fedemnist", num_agents=128, agent_frac=0.25,
                   local_ep=10, bs=64, rounds=min(R, 100), snap=snap,
                   chain=chain, seed=0, synth_train_size=32768,
-                  synth_val_size=1024, tensorboard=False,
-                  data_dir="./data")
+                  synth_val_size=1024, synth_hardness=args.hardness,
+                  tensorboard=False, data_dir="./data")
         configs += [
             ("fedemnist-attack", Config(num_corrupt=13, poison_frac=0.5,
                                         **fe)),
@@ -152,6 +167,7 @@ def main():
     results = [r for r in prior if r["name"] not in ran] + results
     order = ["fmnist-clean", "fmnist-attack", "fmnist-attack-rlr",
              "cifar10-dba-attack", "cifar10-dba-rlr",
+             "cifar10-resnet9-dba-attack", "cifar10-resnet9-dba-rlr",
              "fedemnist-attack", "fedemnist-attack-rlr"]
     results.sort(key=lambda r: order.index(r["name"])
                  if r["name"] in order else len(order))
@@ -179,11 +195,13 @@ def main():
         f"Device: `{device}`; configs are the "
         "reference's canonical triples (src/runner.sh:12-38), "
         f"{R} rounds, eval every {snap} rounds, chained dispatch "
-        f"({chain} rounds/XLA program).",
+        f"({chain} rounds/XLA program). Synthetic-task hardness per row "
+        "is recorded in results.json (`hardness`); rows at different "
+        "hardness are not comparable.",
         "",
         "| config | rounds | val acc | poison acc | val@20 | poison@20 |"
-        " rounds/sec | wall |",
-        "|---|---|---|---|---|---|---|---|",
+        " r/s (wall) | r/s (steady) | wall |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in results:
         s = r["summary"]
@@ -191,11 +209,14 @@ def main():
 
         def fmt(x):
             return f"{x:.3f}" if isinstance(x, float) else "—"
+        steady = s.get("steady_rounds_per_sec")
+        steady_s = f"{steady:.2f}" if steady is not None else "—"
         lines.append(
             f"| {r['name']} | {s.get('round')} | {fmt(s.get('val_acc'))} | "
             f"{fmt(s.get('poison_acc'))} | {fmt(m20.get('val_acc'))} | "
             f"{fmt(m20.get('poison_acc'))} | "
-            f"{s.get('rounds_per_sec', 0):.2f} | {r['wall_s']}s |")
+            f"{s.get('rounds_per_sec', 0):.2f} | {steady_s} | "
+            f"{r['wall_s']}s |")
     lines += [
         "",
         "Raw per-milestone numbers: `results.json`. Regenerate: "

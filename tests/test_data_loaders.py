@@ -95,3 +95,55 @@ def test_fedemnist_too_few_users_raises(tmp_path):
                  data_dir=str(tmp_path))
     with pytest.raises(ValueError, match="refusing to train"):
         get_federated_data(cfg)
+
+
+# ----------------------------------------------------- synthetic hardness ---
+
+def test_synthetic_hardness_zero_is_bit_identical_to_legacy():
+    """hardness=0 must reproduce the round-1 data exactly (RESULTS history
+    and golden tests depend on it)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        make_synthetic)
+    a_tr, a_va = make_synthetic("fmnist", (28, 28, 1), 64, 32, seed=3)
+    b_tr, b_va = make_synthetic("fmnist", (28, 28, 1), 64, 32, seed=3,
+                                hardness=0.0)
+    assert np.array_equal(a_tr.images, b_tr.images)
+    assert np.array_equal(a_tr.labels, b_tr.labels)
+    assert np.array_equal(a_va.images, b_va.images)
+
+
+def test_synthetic_hardness_shifts_are_circular_rolls():
+    """At hardness h, each sample is its (background-mixed) prototype rolled
+    by a per-sample offset <= round(6h), plus noise — verify the underlying
+    roll by checking each clean-prototype nearest-roll distance is small."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        make_synthetic)
+    h = 0.5
+    tr, _ = make_synthetic("fmnist", (28, 28, 1), 16, 4, seed=5, hardness=h)
+    # rebuild the mixed prototypes exactly as make_synthetic does
+    rng = np.random.default_rng(5)
+    protos = rng.uniform(0.15, 0.85, size=(10, 28, 28, 1))
+    shared = rng.uniform(0.15, 0.85, size=(28, 28, 1))
+    protos = (1 - 0.85 * h) * protos + 0.85 * h * shared
+    s = int(round(6 * h))
+    x = tr.images.astype(np.float32) / 255.0
+    for i in range(len(x)):
+        best = min(
+            float(np.mean(np.abs(
+                x[i] - np.roll(protos[tr.labels[i]], (dy, dx), (0, 1)))))
+            for dy in range(-s, s + 1) for dx in range(-s, s + 1))
+        # sigma = 0.10+0.35h = 0.275 -> mean |clipped noise| ~ 0.2; a wrong
+        # class/shift would differ by the prototype scale (~0.3+)
+        assert best < 0.26
+
+
+def test_synthetic_hardness_label_noise_train_only():
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        make_synthetic)
+    # same geometry/seed, hardness toggles label noise on the train split
+    tr0, va0 = make_synthetic("fmnist", (28, 28, 1), 4096, 512, seed=7)
+    tr1, va1 = make_synthetic("fmnist", (28, 28, 1), 4096, 512, seed=7,
+                              hardness=1.0)
+    flipped = np.mean(tr0.labels != tr1.labels)
+    # 10% resampled uniformly -> ~9% actually change class
+    assert 0.04 < flipped < 0.16

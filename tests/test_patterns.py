@@ -99,20 +99,32 @@ def test_real_watermark_assets_pixel_parity():
     import os
     import pytest
     cv2 = pytest.importorskip("cv2")
+    # the package only searches config'd locations (no hardcoded machine
+    # paths); on this build machine the reference checkout has the assets,
+    # so point RLR_ASSET_DIR at it for the duration of the test
     asset_dir = os.environ.get("RLR_ASSET_DIR", "/root/reference")
-    for ptype, fname in (("copyright", "watermark.png"),
-                         ("apple", "apple.png")):
-        path = os.path.join(asset_dir, fname)
-        if not os.path.exists(path):
-            pytest.skip(f"asset {fname} not available")
-        expect = cv2.resize(
-            cv2.bitwise_not(cv2.imread(path, cv2.IMREAD_GRAYSCALE)),
-            dsize=(28, 28), interpolation=cv2.INTER_CUBIC).astype(np.float32)
+    old = os.environ.get("RLR_ASSET_DIR")
+    os.environ["RLR_ASSET_DIR"] = asset_dir
+    try:
+        for ptype, fname in (("copyright", "watermark.png"),
+                             ("apple", "apple.png")):
+            path = os.path.join(asset_dir, fname)
+            if not os.path.exists(path):
+                pytest.skip(f"asset {fname} not available")
+            expect = cv2.resize(
+                cv2.bitwise_not(cv2.imread(path, cv2.IMREAD_GRAYSCALE)),
+                dsize=(28, 28),
+                interpolation=cv2.INTER_CUBIC).astype(np.float32)
 
-        s = build_stamp("fmnist", ptype, data_dir="/nonexistent")
-        np.testing.assert_array_equal(s.value, expect)
-        assert s.mode == "addu8"
+            s = build_stamp("fmnist", ptype, data_dir="/nonexistent")
+            np.testing.assert_array_equal(s.value, expect)
+            assert s.mode == "addu8"
 
-        s_fed = build_stamp("fedemnist", ptype, data_dir="/nonexistent")
-        np.testing.assert_allclose(s_fed.value, expect / 255.0)
-        assert s_fed.mode == "subf"
+            s_fed = build_stamp("fedemnist", ptype, data_dir="/nonexistent")
+            np.testing.assert_allclose(s_fed.value, expect / 255.0)
+            assert s_fed.mode == "subf"
+    finally:
+        if old is None:
+            os.environ.pop("RLR_ASSET_DIR", None)
+        else:
+            os.environ["RLR_ASSET_DIR"] = old
