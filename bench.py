@@ -96,8 +96,10 @@ def main():
     if cpu_fallback:
         # this host has very few cores; the full 60k config would run for
         # an hour — shrink the dataset (same agent/epoch/batch structure)
-        # so the fallback still emits a number in a few minutes
-        args.chain = min(args.chain, 5)
+        # so the fallback still emits a number in a few minutes. chain=1:
+        # the chained rounds-scan is a while loop and XLA:CPU executes
+        # convs inside while loops via a slow reference path (fl/client.py)
+        args.chain = 1
         args.blocks = min(args.blocks, 2)
 
     import jax.numpy as jnp

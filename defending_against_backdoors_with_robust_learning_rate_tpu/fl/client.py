@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
     masked_ce)
-from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import (
+    loops, tree)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.sgd import (
     clip_by_global_norm, pgd_project, sgd_momentum_step)
 
@@ -44,6 +45,10 @@ def make_local_train(model, cfg, normalize):
     def local_train(params0, images, labels, size, key):
         n_total = images.shape[0]
         nb = n_total // bs
+        # policy for ops/loops.maybe_unrolled_scan (XLA:CPU conv-in-while
+        # slow path): trace short local loops as Python loops on CPU,
+        # capped at 16 fwd+bwd steps to keep trace/compile time sane
+        py_loops = loops.cpu_backend() and cfg.local_ep * nb <= 16
         params0 = tree.astype(params0, jnp.float32)
 
         def epoch_body(carry, ep_key):
@@ -76,15 +81,16 @@ def make_local_train(model, cfg, normalize):
                     params = pgd_project(params, params0, cfg.clip)
                 return (params, mom), (loss * w_n, w_n)
 
-            (params, mom), (loss_sums, w_sums) = jax.lax.scan(
-                batch_body, (params, mom), jnp.arange(nb))
+            (params, mom), (loss_sums, w_sums) = loops.maybe_unrolled_scan(
+                batch_body, (params, mom), jnp.arange(nb), py_loops)
             # sample-weighted epoch loss: padding batches contribute nothing
             ep_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
             return (params, mom), ep_loss
 
         ep_keys = jax.random.split(key, cfg.local_ep)
-        (params, _), ep_losses = jax.lax.scan(
-            epoch_body, (params0, tree.zeros_like(params0)), ep_keys)
+        (params, _), ep_losses = loops.maybe_unrolled_scan(
+            epoch_body, (params0, tree.zeros_like(params0)), ep_keys,
+            py_loops)
         update = tree.sub(params, params0)
         return update, jnp.mean(ep_losses)
 

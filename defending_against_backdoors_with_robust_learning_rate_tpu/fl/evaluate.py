@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import loops
+
 
 def pad_eval_set(images: np.ndarray, labels: np.ndarray, bs: int
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -51,8 +53,12 @@ def make_eval_fn(model, normalize, n_classes: int = 10):
 
         init = (jnp.float32(0.0), jnp.float32(0.0),
                 jnp.zeros((n_classes, n_classes), jnp.float32))
-        (loss_sum, correct, conf), _ = jax.lax.scan(
-            body, init, (images, labels, weights))
+        # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short eval
+        # loops; the cap is higher than local training's (32 vs 16) because
+        # the fwd-only body is ~3x cheaper to trace/compile per step
+        py_loops = loops.cpu_backend() and images.shape[0] <= 32
+        (loss_sum, correct, conf), _ = loops.maybe_unrolled_scan(
+            body, init, (images, labels, weights), py_loops)
         n = jnp.sum(weights)
         per_class = jnp.diag(conf) / jnp.maximum(jnp.sum(conf, axis=1), 1.0)
         # f32 rounding can push correct/n a hair above 1.0 (round-1

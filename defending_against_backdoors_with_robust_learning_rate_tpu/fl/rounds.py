@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
     make_local_train)
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import loops
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     aggregate_updates, apply_aggregate, robust_lr)
 
@@ -77,7 +78,8 @@ def make_chained(step):
     """Wrap a step(params, key) closure into chained(params, base_key,
     round_ids): a `lax.scan` over rounds, round r keyed by
     `fold_in(base_key, r)` (the driver loop's exact derivation — chained
-    blocks are bit-identical to per-round dispatch). Shared by the
+    blocks match per-round dispatch to ~1 ulp — same ops and keys,
+    fusion may round differently). Shared by the
     single-device and sharded paths; info is reduced to the scannable
     train_loss/sampled leaves."""
     @functools.partial(jax.jit, donate_argnums=0)
@@ -87,7 +89,10 @@ def make_chained(step):
             return new_params, {"train_loss": info["train_loss"],
                                 "sampled": info["sampled"]}
 
-        return jax.lax.scan(body, params, round_ids)
+        # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short
+        # chains; each chain step is a whole round so the cap stays small
+        py_loops = loops.cpu_backend() and round_ids.shape[0] <= 16
+        return loops.maybe_unrolled_scan(body, params, round_ids, py_loops)
 
     return chained
 
@@ -99,8 +104,8 @@ def _make_sample_step(cfg, model, normalize, images, labels, sizes):
     in-jit, and runs the round core. The key-derivation order (sample, train,
     noise) matches parallel/rounds.py so the sharded and single-device paths
     are comparable round-for-round — and both the per-round and chained fns
-    wrap THIS closure, which is what makes chained execution bit-identical
-    to per-round dispatch."""
+    wrap THIS closure, which is what makes chained execution match
+    per-round dispatch (same ops/keys; ~1 ulp fusion differences)."""
     local_train = make_local_train(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
 
@@ -135,7 +140,7 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
     over the round ids — the per-round host dispatch of the reference loop
     (src/federated.py:65) disappears entirely. Round r's key is
     `fold_in(base_key, r)`, exactly the driver loop's derivation, so a chained
-    block is bit-identical to dispatching the same rounds one at a time.
+    block matches dispatching the same rounds one at a time (~1 ulp).
 
     info leaves are stacked per-round ([n_chain, ...]). Diagnostics extras are
     not supported here (the driver runs diagnostic snap rounds unchained).

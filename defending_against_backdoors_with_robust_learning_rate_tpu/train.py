@@ -263,6 +263,8 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     # understates throughput on short runs)
     t_steady = None
     rounds_at_steady = 0
+    t_steady_end = None
+    rounds_at_steady_end = 0
     rnd = start_round
     while rnd < cfg.rounds:
         # rounds until the next eval boundary (or the end of the run)
@@ -362,6 +364,13 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 # path has now compiled at least once
                 t_steady = time.perf_counter()
                 rounds_at_steady = rounds_done
+            else:
+                # steady window always ends at a snap boundary: a final
+                # partial segment (rounds % snap != 0) may fall back to the
+                # never-yet-compiled unchained round fn, and that compile
+                # must not pollute the compile-free metric
+                t_steady_end = time.perf_counter()
+                rounds_at_steady_end = rounds_done
         writer.flush()
 
     if cfg.profile_dir and lead:
@@ -370,10 +379,11 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     elapsed = time.perf_counter() - t_loop
     summary.setdefault("round", cfg.rounds)
     summary["rounds_per_sec"] = rounds_done / max(elapsed, 1e-9)
-    if t_steady is not None and rounds_done > rounds_at_steady:
+    if (t_steady is not None and t_steady_end is not None
+            and rounds_at_steady_end > rounds_at_steady):
         summary["steady_rounds_per_sec"] = (
-            (rounds_done - rounds_at_steady)
-            / max(time.perf_counter() - t_steady, 1e-9))
+            (rounds_at_steady_end - rounds_at_steady)
+            / max(t_steady_end - t_steady, 1e-9))
     summary["params"] = param_count(params)
     print("Training has finished!")
     print(f"[throughput] {summary['rounds_per_sec']:.3f} rounds/sec "

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Regenerate the reference's two README figures from results.json.
+
+The reference publishes `performance.png` (clean validation accuracy over
+rounds) and `poison_acc.png` (backdoor success rate over rounds) as its only
+result artifacts (reference README.md:30-34). This renders the same two
+figures from the curves recorded by scripts/run_baselines.py.
+
+Encoding: color = dataset family (fixed order, Okabe-Ito colorblind-safe
+hues — the palette validator of the dataviz method isn't runnable in this
+image (no node), so the published Wong/Okabe-Ito palette is used as-is),
+linestyle = experiment variant (clean dotted / attack solid / +RLR dashed),
+so identity is never color-alone. One y-axis per figure, recessive grid,
+legend always present.
+
+Usage: python scripts/plot_curves.py [--results results.json] [--outdir .]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# fixed hue order per dataset family — never cycled
+FAMILY_COLOR = {
+    "fmnist": "#0072B2",            # blue
+    "cifar10": "#E69F00",           # orange
+    "cifar10-resnet9": "#009E73",   # bluish green
+    "fedemnist": "#CC79A7",         # reddish purple
+}
+VARIANT_STYLE = {"clean": ":", "attack": "-", "rlr": "--"}
+
+
+def split_name(name: str):
+    """'cifar10-resnet9-dba-rlr' -> ('cifar10-resnet9', 'rlr')."""
+    variant = ("rlr" if name.endswith("-rlr")
+               else "clean" if name.endswith("-clean") else "attack")
+    family = name
+    for suf in ("-clean", "-attack", "-dba-attack", "-dba-rlr",
+                "-attack-rlr", "-rlr"):
+        if family.endswith(suf):
+            family = family[: -len(suf)]
+            break
+    return family, variant
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results", default="results.json")
+    ap.add_argument("--outdir", default=".")
+    args = ap.parse_args()
+
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        sys.exit("matplotlib is not available in this environment")
+
+    with open(args.results) as f:
+        results = json.load(f)
+
+    figures = [
+        ("performance.png", "Validation/Accuracy",
+         "Clean validation accuracy"),
+        ("poison_acc.png", "Poison/Poison_Accuracy",
+         "Backdoor success rate"),
+    ]
+    for fname, tag, title in figures:
+        fig, ax = plt.subplots(figsize=(7, 4.2), dpi=150)
+        for r in results:
+            curves = r.get("curves")
+            if not curves:
+                continue
+            steps = sorted(int(s) for s in curves)
+            ys = [curves[str(s)].get(tag) for s in steps]
+            pts = [(s, y) for s, y in zip(steps, ys) if y is not None]
+            if not pts:
+                continue
+            family, variant = split_name(r["name"])
+            ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                    VARIANT_STYLE[variant],
+                    color=FAMILY_COLOR.get(family, "#555555"),
+                    linewidth=1.6, label=r["name"])
+        ax.set_xlabel("FL round")
+        ax.set_ylabel(title)
+        ax.set_ylim(-0.02, 1.02)
+        ax.grid(True, color="#dddddd", linewidth=0.6)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        ax.legend(fontsize=7, frameon=False, ncol=2)
+        ax.set_title(title, fontsize=11)
+        out = os.path.join(args.outdir, fname)
+        fig.tight_layout()
+        fig.savefig(out)
+        plt.close(fig)
+        print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

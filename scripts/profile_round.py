@@ -54,9 +54,18 @@ def main():
     from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
         aggregate_updates, apply_aggregate, robust_lr)
 
+    # on CPU, shrink the dataset so local_ep*nb stays under the py-loop cap
+    # (ops/loops.py): the full 60k config would run the 46-step scan on
+    # XLA:CPU's slow conv-in-while path and never finish on a laptop-class
+    # host; the TPU numbers are the ones that matter
+    on_cpu = (args.platform == "cpu" or jax.default_backend() == "cpu")
     cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
                  num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
-                 synth_train_size=60000, synth_val_size=10000, seed=0)
+                 synth_train_size=(6000 if on_cpu else 60000),
+                 synth_val_size=(1000 if on_cpu else 10000), seed=0)
+    if on_cpu:
+        print("[profile] CPU backend: reduced shapes (6k train) — timings "
+              "are not comparable to TPU rows", flush=True)
     fed = get_federated_data(cfg)
     model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
     params = init_params(model, fed.train.images.shape[2:],

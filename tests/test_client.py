@@ -113,3 +113,34 @@ def test_pgd_clip_bounds_update_norm():
         params, jnp.asarray(x), jnp.asarray(y), jnp.int32(8),
         jax.random.PRNGKey(3))
     assert float(tree.norm(up)) <= 0.05 + 1e-5
+
+
+def test_python_loop_path_matches_scan(monkeypatch):
+    """ops/loops.maybe_unrolled_scan's Python path must be bit-identical to
+    lax.scan: on CPU all parity tests take the Python path and on TPU all
+    take scan, so without forcing both on ONE backend a divergence slipped
+    into either path would pass the whole suite (code review r2)."""
+    shape = (4, 4, 1)
+    rng = np.random.default_rng(9)
+    x = rng.normal(0.5, 0.2, size=(12,) + shape).astype(np.float32)
+    y = rng.integers(0, 4, size=12).astype(np.int32)
+    model = TinyNet()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1,) + shape))["params"]
+    norm = make_normalizer((0,), (1,), True)
+    cfg = Config(bs=4, local_ep=2, client_moment=0.9)
+    args = (params, jnp.asarray(x), jnp.asarray(y), jnp.int32(10),
+            jax.random.PRNGKey(11))
+
+    monkeypatch.setenv("RLR_SCAN_MODE", "python")
+    up_py, loss_py = jax.jit(make_local_train(model, cfg, norm))(*args)
+    monkeypatch.setenv("RLR_SCAN_MODE", "scan")
+    up_scan, loss_scan = jax.jit(make_local_train(model, cfg, norm))(*args)
+
+    # same ops and key derivations; XLA fuses the unrolled program
+    # differently so results match to ~1 ulp, not bitwise (measured 3e-8)
+    np.testing.assert_allclose(float(loss_py), float(loss_scan), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(up_py),
+                    jax.tree_util.tree_leaves(up_scan)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
