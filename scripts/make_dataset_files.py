@@ -135,8 +135,9 @@ def main():
         make_cifar10(args.data_dir, 50000 if args.train == 60000
                      else args.train, args.val, args.seed, args.hardness)
     if not args.only or "fedemnist" in args.only:
-        make_fedemnist(args.data_dir, min(args.train, 32768), 1024,
-                       args.users, args.seed, args.hardness)
+        n_tr = min(args.train, 32768)
+        make_fedemnist(args.data_dir, n_tr, min(args.val, 1024),
+                       min(args.users, n_tr), args.seed, args.hardness)
 
 
 if __name__ == "__main__":

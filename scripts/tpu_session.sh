@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement session (round-2 VERDICT items #2,#3,#4,#7,#8).
+# Probes the tunneled TPU first (bounded) and refuses to start if it is
+# wedged, so nothing here can hang the driver. Each step appends to
+# logs/tpu_session.log. Run from the repo root.
+set -u
+cd "$(dirname "$0")/.."
+LOG=logs/tpu_session.log
+mkdir -p logs
+stamp() { date "+%F %T"; }
+say() { echo "[$(stamp)] $*" | tee -a "$LOG"; }
+
+say "probing TPU backend (45s budget)..."
+if ! timeout 45 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1; then
+    say "TPU unreachable — aborting (wedged tunnel); re-run later"
+    exit 1
+fi
+say "TPU alive"
+
+say "step 1/4: materialize real-format dataset files (hardness 0.5)"
+python scripts/make_dataset_files.py --data_dir=./data --hardness=0.5 \
+    >>"$LOG" 2>&1 || say "WARN: make_dataset_files failed (runs will use the in-memory fallback)"
+
+say "step 2/4: full baselines regen (9 configs incl. ResNet-9)"
+python scripts/run_baselines.py --hardness=0.5 >>"$LOG" 2>&1 \
+    && say "baselines done" || say "WARN: run_baselines rc=$?"
+
+say "step 3/4: regenerate curve figures"
+python scripts/plot_curves.py >>"$LOG" 2>&1 || say "WARN: plot failed"
+
+say "step 4/4: component profile"
+python scripts/profile_round.py >>"$LOG" 2>&1 || say "WARN: profile failed"
+
+say "session complete — review RESULTS.md, results.json, *.png, $LOG"

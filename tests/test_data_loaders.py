@@ -147,3 +147,65 @@ def test_synthetic_hardness_label_noise_train_only():
     flipped = np.mean(tr0.labels != tr1.labels)
     # 10% resampled uniformly -> ~9% actually change class
     assert 0.04 < flipped < 0.16
+
+
+# ------------------------------------------- real-format file round-trip ---
+
+def test_make_dataset_files_roundtrip_fmnist(tmp_path):
+    """scripts/make_dataset_files.py writes the synthetic task into the real
+    on-disk formats; loading through the production parsers must return the
+    same arrays the in-memory fallback would (so RESULTS runs that use the
+    files are comparable AND exercise the real loader path, VERDICT r1 C4)."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "scripts/make_dataset_files.py",
+         f"--data_dir={tmp_path}", "--train=96", "--val=32",
+         "--hardness=0.5", "--only=fmnist"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        _load_fmnist, make_synthetic)
+    got = _load_fmnist(str(tmp_path))
+    assert got is not None
+    tr, va = got
+    etr, eva = make_synthetic("fmnist", (28, 28, 1), 96, 32, seed=0,
+                              hardness=0.5)
+    assert np.array_equal(tr.images, etr.images)
+    assert np.array_equal(tr.labels, etr.labels)
+    assert np.array_equal(va.images, eva.images)
+    assert np.array_equal(va.labels, eva.labels)
+
+
+def test_make_dataset_files_roundtrip_cifar_fedemnist(tmp_path):
+    import subprocess
+    import sys
+    torch = pytest.importorskip("torch")
+    r = subprocess.run(
+        [sys.executable, "scripts/make_dataset_files.py",
+         f"--data_dir={tmp_path}", "--train=100", "--val=20", "--users=4",
+         "--hardness=0.5", "--only=cifar10,fedemnist"],
+        capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        _load_cifar10, _load_fedemnist, make_synthetic)
+    got = _load_cifar10(str(tmp_path))
+    assert got is not None
+    tr, va = got
+    etr, eva = make_synthetic("cifar10", (32, 32, 3), 100, 20, seed=0,
+                              hardness=0.5)
+    assert np.array_equal(tr.images, etr.images)
+    assert np.array_equal(tr.labels, etr.labels)
+    assert np.array_equal(va.images, eva.images)
+    assert np.array_equal(va.labels, eva.labels)
+
+    fed = _load_fedemnist(str(tmp_path))
+    assert fed is not None
+    shards, val = fed
+    assert len(shards) == 4
+    # user shards partition the train split exactly
+    assert sum(len(y) for _, y in shards) == 100
+    assert val.images.shape == (20, 28, 28, 1)
+    assert val.images.dtype == np.float32

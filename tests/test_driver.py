@@ -51,3 +51,19 @@ def test_driver_mesh_device_resident_with_rlr():
     summary = _run(BASE.replace(mesh=0, num_corrupt=2, poison_frac=1.0,
                                 robustLR_threshold=4))
     assert summary["round"] == 4 and np.isfinite(summary["val_acc"])
+
+
+def test_driver_reports_steady_throughput():
+    """steady_rounds_per_sec: window opens at the first snap boundary and
+    closes at the last one, so first-compile time and a final partial
+    segment's fresh round_fn compile are both excluded (VERDICT r1 #9)."""
+    # rounds=5, snap=2: boundaries at 2 and 4; round 5 is a partial tail
+    # (summary["round"] records the last EVALUATED round, i.e. 4)
+    cfg = BASE.replace(rounds=5, snap=2, chain=2)
+    summary = _run(cfg)
+    assert summary["round"] == 4
+    assert "steady_rounds_per_sec" in summary
+    assert summary["steady_rounds_per_sec"] > 0
+    # wall-clock figure exists alongside and includes compile, so the
+    # steady figure can only be >= it on these tiny runs
+    assert summary["steady_rounds_per_sec"] >= summary["rounds_per_sec"]
