@@ -39,24 +39,41 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def probe_backend(timeout_s: float) -> str | None:
+def probe_backend(timeout_s: float, retries: int = 3,
+                  retry_wait_s: float = 45.0) -> str | None:
     """Return the default backend name, probed in a bounded subprocess.
 
     None means the backend never came up within the budget (wedged tunnel /
     missing hardware). Only the *probe* child is ever killed — it does no
-    compilation, so killing it cannot wedge a healthy chip mid-compile."""
+    compilation, so killing it cannot wedge a healthy chip mid-compile.
+    A wedge can clear between attempts, so a failed probe is retried a few
+    times (total worst case: retries * (timeout_s + retry_wait_s), still
+    bounded) before giving up."""
     code = "import jax; print('BACKEND=' + jax.default_backend())"
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return None
-    if out.returncode != 0:
-        return None
-    for line in out.stdout.splitlines():
-        if line.startswith("BACKEND="):
-            return line.split("=", 1)[1]
+    for attempt in range(retries):
+        timed_out = False
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            out, timed_out = None, True
+        if out is not None and out.returncode == 0:
+            for line in out.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1]
+        why = ("timed out (wedged tunnel?)" if timed_out else
+               f"rc={out.returncode}: {out.stderr.strip()[-300:]}")
+        if attempt < retries - 1:
+            # a hang can clear between attempts, so wait before re-probing;
+            # a fast deterministic failure won't, so don't
+            wait = retry_wait_s if timed_out else 0.0
+            log(f"[bench] probe attempt {attempt + 1}/{retries} failed "
+                f"({why}); retrying" + (f" in {wait:.0f}s" if wait else ""))
+            time.sleep(wait)
+        else:
+            log(f"[bench] probe attempt {attempt + 1}/{retries} failed "
+                f"({why})")
     return None
 
 

@@ -92,6 +92,27 @@ def _pairwise_sq_dists(stacked_updates):
     return jnp.maximum(d, 0.0)
 
 
+def trmean_k(trim_k: int, m: int) -> int:
+    """Clamp the per-end trim count so at least one value survives; shared
+    by the vmap and sharded trmean paths (their parity depends on it)."""
+    return max(0, min(int(trim_k), (m - 1) // 2))
+
+
+def agg_trmean(stacked_updates, trim_k: int):
+    """Coordinate-wise trimmed mean: drop the trim_k smallest and largest
+    values per coordinate, average the rest (framework extension; standard
+    robust aggregation, Yin et al. 2018 — not in the reference, which has
+    avg/comed/sign only). trim_k is clamped so at least one value remains;
+    trim_k=0 degrades to the unweighted mean."""
+    m = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
+    k = trmean_k(trim_k, m)
+
+    def leaf(u):
+        srt = jnp.sort(u, axis=0)
+        return jnp.mean(srt[k:m - k], axis=0)
+    return tree.map(leaf, stacked_updates)
+
+
 def agg_krum(stacked_updates, num_corrupt: int = 0):
     """Krum: select the update with the smallest sum of its m-f-2 nearest
     squared distances (framework extension; BASELINE.json configs[4])."""
@@ -122,6 +143,8 @@ def aggregate_updates(stacked_updates, data_sizes, cfg, key):
         agg = agg_comed(stacked_updates)
     elif cfg.aggr == "sign":
         agg = agg_sign(stacked_updates)
+    elif cfg.aggr == "trmean":
+        agg = agg_trmean(stacked_updates, cfg.num_corrupt)
     elif cfg.aggr == "krum":
         agg = agg_krum(stacked_updates, cfg.num_corrupt)
     else:

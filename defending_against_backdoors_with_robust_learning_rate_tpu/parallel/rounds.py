@@ -39,7 +39,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import 
     make_local_train)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
-    apply_aggregate, gaussian_noise_like, sq_dist_accum)
+    apply_aggregate, gaussian_noise_like, sq_dist_accum, trmean_k)
 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
     AGENTS_AXIS)
 
@@ -88,6 +88,18 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
             chunk, L = _to_param_shards(u, d)            # [m, c]
             med = jnp.sort(chunk, axis=0)[(m - 1) // 2]  # torch lower median
             return _from_param_shard(med, L, u.shape[1:])
+        agg = tree.map(leaf, updates)
+    elif cfg.aggr == "trmean":
+        # coordinate-wise trimmed mean rides the same param-sharded
+        # transpose as comed: sort the [m, c] chunk, mean the untrimmed
+        # middle band (ops/aggregate.agg_trmean semantics)
+        m = cfg.agents_per_round
+        k = trmean_k(cfg.num_corrupt, m)
+
+        def leaf(u):
+            chunk, L = _to_param_shards(u, d)            # [m, c]
+            band = jnp.sort(chunk, axis=0)[k:m - k]
+            return _from_param_shard(jnp.mean(band, axis=0), L, u.shape[1:])
         agg = tree.map(leaf, updates)
     elif cfg.aggr == "krum":
         m = cfg.agents_per_round

@@ -10,8 +10,8 @@ import torch
 from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
-    agg_avg, agg_comed, agg_krum, agg_sign, aggregate_updates, apply_aggregate,
-    robust_lr)
+    agg_avg, agg_comed, agg_krum, agg_sign, agg_trmean, aggregate_updates,
+    apply_aggregate, robust_lr)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.sgd import (
     clip_by_global_norm, pgd_project, sgd_momentum_step)
 
@@ -110,6 +110,21 @@ def test_agg_sign():
                            [-1.0, -5.0, 0.0]])}
     np.testing.assert_array_equal(np.asarray(agg_sign(u)["w"]),
                                   [1.0, -1.0, 0.0])
+
+
+def test_agg_trmean_drops_extremes():
+    """Trimmed mean (k=1) over [m, n]: per coordinate, min and max are
+    dropped, the rest averaged — outliers cannot move the aggregate."""
+    u = {"w": jnp.asarray([[100.0, -7.0], [1.0, 2.0],
+                           [3.0, 4.0], [-50.0, 100.0]])}
+    out = np.asarray(agg_trmean(u, trim_k=1)["w"])
+    np.testing.assert_allclose(out, [(1 + 3) / 2, (2 + 4) / 2])
+    # trim_k clamps so at least one value survives; k=0 is the plain mean
+    out0 = np.asarray(agg_trmean(u, trim_k=0)["w"])
+    np.testing.assert_allclose(out0, np.asarray(u["w"]).mean(0))
+    out_big = np.asarray(agg_trmean(u, trim_k=99)["w"])
+    np.testing.assert_allclose(out_big, np.sort(np.asarray(u["w"]),
+                                                axis=0)[1:3].mean(0))
 
 
 def test_agg_krum_drops_outlier():
