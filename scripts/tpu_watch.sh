@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Detachable watcher: probe the TPU every ~9 min; when it answers, run the
+# full measurement session (scripts/tpu_session.sh). Writes progress to
+# logs/tpu_watch.log. Start with:
+#   nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.."
+mkdir -p logs
+W=logs/tpu_watch.log
+for i in $(seq 1 60); do
+  if timeout 45 python -c "import jax; jax.devices()" >>"$W" 2>&1; then
+    echo "[watcher] TPU alive at $(date); launching session" >>"$W"
+    bash scripts/tpu_session.sh >>"$W" 2>&1
+    echo "[watcher] session rc=$? at $(date)" >>"$W"
+    exit 0
+  fi
+  echo "[watcher] probe $i: wedged at $(date)" >>"$W"
+  sleep 520
+done
+echo "[watcher] gave up after $i probes at $(date)" >>"$W"
