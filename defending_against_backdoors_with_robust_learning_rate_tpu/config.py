@@ -65,6 +65,12 @@ class Config:
     chain: int = 1                  # rounds fused per dispatch via lax.scan
                                     # (capped at `snap`; >1 kills per-round
                                     # host dispatch overhead, bit-identical)
+    host_prefetch: int = 2          # host-sampled mode: rounds of shard
+                                    # stacks gathered + device_put ahead of
+                                    # the compute (0 = synchronous gather)
+    host_sampled: str = "auto"      # auto: shard stacks above the device-
+                                    # resident budget (2 GiB) gather on host
+                                    # per round; on/off forces the mode
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -188,6 +194,14 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--chain", type=int, default=d.chain,
                    help="rounds fused into one compiled lax.scan dispatch "
                         "(capped at --snap so eval cadence is unchanged)")
+    p.add_argument("--host_prefetch", type=int, default=d.host_prefetch,
+                   help="host-sampled mode: rounds of shard stacks gathered "
+                        "+ device_put ahead of the compute (0=synchronous)")
+    p.add_argument("--host_sampled", choices=("auto", "on", "off"),
+                   default=d.host_sampled,
+                   help="force host-sampled shard gathering on/off "
+                        "(auto: stacks above the 2 GiB device-resident "
+                        "budget gather on host per round)")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--log_dir", type=str, default=d.log_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)

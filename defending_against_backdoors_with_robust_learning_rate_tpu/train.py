@@ -56,7 +56,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     print(f"[model] {type(model).__name__}: {param_count(params):,} params")
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
 
-    host_mode = fed.train.images.nbytes > DEVICE_RESIDENT_BYTES
+    host_mode = (cfg.host_sampled == "on"
+                 or (cfg.host_sampled == "auto"
+                     and fed.train.images.nbytes > DEVICE_RESIDENT_BYTES))
     n_mesh = 1
     if cfg.mesh != 1 and not host_mode:
         from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
@@ -70,6 +72,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     plain_cfg = cfg.replace(diagnostics=False)
     host_sampler = None
     chained_fn = None
+    prefetcher = None   # host-mode RoundPrefetcher, created lazily
     # a diagnostic snap round always runs unchained, so it is excluded from
     # the per-boundary chain budget
     chain_n = max(1, min(cfg.chain,
@@ -153,18 +156,37 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
                                   if cfg.diagnostics else round_fn_host)
 
-        def host_sampler(params, key, rnd, want_diag):
+        def gather_round(rnd):
             # per-round generator so --resume continues the same sampling
             # sequence the uninterrupted run would have used
             rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
             ids = rng.choice(cfg.num_agents, cfg.agents_per_round,
                              replace=False)
+            return (ids, shard_put(fed.train.images[ids]),
+                    shard_put(fed.train.labels[ids]),
+                    shard_put(fed.train.sizes[ids]))
+
+        # host gather + H2D transfer overlap the running round program
+        # (data/prefetch.py); created lazily at the first round so a resumed
+        # run prefetches from its restored start round
+        if cfg.host_prefetch > 0:
+            print(f"[prefetch] host->device pipeline, depth "
+                  f"{cfg.host_prefetch}")
+
+        def host_sampler(params, key, rnd, want_diag):
+            nonlocal prefetcher
+            if cfg.host_prefetch > 0:
+                if prefetcher is None:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+                        RoundPrefetcher)
+                    prefetcher = RoundPrefetcher(
+                        gather_round, range(rnd, cfg.rounds + 1),
+                        depth=cfg.host_prefetch)
+                ids, imgs, lbls, szs = prefetcher.get(rnd)
+            else:
+                ids, imgs, lbls, szs = gather_round(rnd)
             fn = diag_round_fn_host if want_diag else round_fn_host
-            new_params, info = fn(
-                params, key,
-                shard_put(fed.train.images[ids]),
-                shard_put(fed.train.labels[ids]),
-                shard_put(fed.train.sizes[ids]))
+            new_params, info = fn(params, key, imgs, lbls, szs)
             info["sampled"] = ids
             return new_params, info
     else:
@@ -266,112 +288,118 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     t_steady_end = None
     rounds_at_steady_end = 0
     rnd = start_round
-    while rnd < cfg.rounds:
-        # rounds until the next eval boundary (or the end of the run)
-        to_eval = min(cfg.snap - rnd % cfg.snap, cfg.rounds - rnd)
-        # a diagnostic snap round must run unchained (it needs prev_params
-        # and the diag-compiled variant), so it is excluded from the budget
-        # — but only when the block actually ends on a snap round (the run
-        # may end mid-interval)
-        diag_at_boundary = cfg.diagnostics and (rnd + to_eval) % cfg.snap == 0
-        budget = to_eval - (1 if diag_at_boundary else 0)
-        if chained_fn is not None and budget >= chain_n:
-            # fixed block length => one compilation serves every block
-            ids = jnp.arange(rnd + 1, rnd + chain_n + 1)
-            params, stacked = chained_fn(params, base_key, ids)
-            rnd += chain_n
-            rounds_done += chain_n
-            info = {"train_loss": stacked["train_loss"][-1]}
-            want_diag, prev_params = False, None
-        else:
-            rnd += 1
-            key = jax.random.fold_in(base_key, rnd)
-            snap_round = rnd % cfg.snap == 0
-            want_diag = cfg.diagnostics and snap_round
-            prev_params = params if want_diag else None
-            if host_sampler is not None:
-                params, info = host_sampler(params, key, rnd, want_diag)
+    # any exception must still tear down the prefetch worker —
+    # it pins device arrays and would leak per failed run
+    try:
+        while rnd < cfg.rounds:
+            # rounds until the next eval boundary (or the end of the run)
+            to_eval = min(cfg.snap - rnd % cfg.snap, cfg.rounds - rnd)
+            # a diagnostic snap round must run unchained (it needs prev_params
+            # and the diag-compiled variant), so it is excluded from the budget
+            # — but only when the block actually ends on a snap round (the run
+            # may end mid-interval)
+            diag_at_boundary = cfg.diagnostics and (rnd + to_eval) % cfg.snap == 0
+            budget = to_eval - (1 if diag_at_boundary else 0)
+            if chained_fn is not None and budget >= chain_n:
+                # fixed block length => one compilation serves every block
+                ids = jnp.arange(rnd + 1, rnd + chain_n + 1)
+                params, stacked = chained_fn(params, base_key, ids)
+                rnd += chain_n
+                rounds_done += chain_n
+                info = {"train_loss": stacked["train_loss"][-1]}
+                want_diag, prev_params = False, None
             else:
-                params, info = (diag_round_fn if want_diag else round_fn)(
-                    params, key)
-            rounds_done += 1
+                rnd += 1
+                key = jax.random.fold_in(base_key, rnd)
+                snap_round = rnd % cfg.snap == 0
+                want_diag = cfg.diagnostics and snap_round
+                prev_params = params if want_diag else None
+                if host_sampler is not None:
+                    params, info = host_sampler(params, key, rnd, want_diag)
+                else:
+                    params, info = (diag_round_fn if want_diag else round_fn)(
+                        params, key)
+                rounds_done += 1
 
-        if want_diag:
-            if "agent_norms" in info:
-                for tag, v in norm_scalars(info["agent_norms"],
-                                           info["sampled"],
-                                           cfg.num_corrupt).items():
-                    writer.scalar(tag, v, rnd)
-            if "lr_flat" in info:
-                from jax.flatten_util import ravel_pytree
-                # Fisher at the pre-update params (aggregation.py:146-148)
-                f_adv = ravel_pytree(fisher_fn(prev_params, *pval))[0]
-                hon_labels = jnp.full_like(pval[1], cfg.base_class)
-                f_hon = ravel_pytree(
-                    fisher_fn(prev_params, pval[0], hon_labels, pval[2]))[0]
-                upd_flat = (ravel_pytree(params)[0]
-                            - ravel_pytree(prev_params)[0])
-                scalars, cum_net_mov = sign_agreement(
-                    np.asarray(info["lr_flat"]), np.asarray(upd_flat),
-                    np.asarray(f_adv), np.asarray(f_hon),
-                    cfg.top_frac, cfg.effective_server_lr, cum_net_mov)
-                for tag, v in scalars.items():
-                    writer.scalar(tag, v, rnd)
+            if want_diag:
+                if "agent_norms" in info:
+                    for tag, v in norm_scalars(info["agent_norms"],
+                                               info["sampled"],
+                                               cfg.num_corrupt).items():
+                        writer.scalar(tag, v, rnd)
+                if "lr_flat" in info:
+                    from jax.flatten_util import ravel_pytree
+                    # Fisher at the pre-update params (aggregation.py:146-148)
+                    f_adv = ravel_pytree(fisher_fn(prev_params, *pval))[0]
+                    hon_labels = jnp.full_like(pval[1], cfg.base_class)
+                    f_hon = ravel_pytree(
+                        fisher_fn(prev_params, pval[0], hon_labels, pval[2]))[0]
+                    upd_flat = (ravel_pytree(params)[0]
+                                - ravel_pytree(prev_params)[0])
+                    scalars, cum_net_mov = sign_agreement(
+                        np.asarray(info["lr_flat"]), np.asarray(upd_flat),
+                        np.asarray(f_adv), np.asarray(f_hon),
+                        cfg.top_frac, cfg.effective_server_lr, cum_net_mov)
+                    for tag, v in scalars.items():
+                        writer.scalar(tag, v, rnd)
 
-        if rnd % cfg.snap == 0:
-            # divergence aborts only under --debug_nan; otherwise it warns
-            # and the run keeps recording its (NaN) metrics
-            assert_finite_params(params, where=f"round {rnd}",
-                                 raise_error=cfg.debug_nan)
-            val_loss, val_acc, per_class = eval_fn(params, *val)
-            poison_loss, poison_acc, _ = eval_fn(params, *pval)
-            val_loss, val_acc = float(val_loss), float(val_acc)
-            poison_loss, poison_acc = float(poison_loss), float(poison_acc)
-            cum_poison_acc += poison_acc
-            # scalar names preserved from src/federated.py:81-91
-            writer.scalar("Validation/Loss", val_loss, rnd)
-            writer.scalar("Validation/Accuracy", val_acc, rnd)
-            writer.scalar("Poison/Base_Class_Accuracy",
-                          float(per_class[cfg.base_class]), rnd)
-            writer.scalar("Poison/Poison_Accuracy", poison_acc, rnd)
-            writer.scalar("Poison/Poison_Loss", poison_loss, rnd)
-            writer.scalar("Poison/Cumulative_Poison_Accuracy_Mean",
-                          cum_poison_acc / rnd, rnd)
-            writer.scalar("Train/Loss", float(info["train_loss"]), rnd)
-            elapsed = time.perf_counter() - t_loop
-            writer.scalar("Throughput/Rounds_Per_Sec",
-                          rounds_done / elapsed, rnd)
-            if t_steady is not None and rounds_done > rounds_at_steady:
-                writer.scalar(
-                    "Throughput/Steady_Rounds_Per_Sec",
-                    (rounds_done - rounds_at_steady)
-                    / (time.perf_counter() - t_steady), rnd)
-            print(f'| Rnd {rnd}: Val_Loss/Val_Acc: {val_loss:.3f} / '
-                  f'{val_acc:.3f} |')
-            print(f'| Rnd {rnd}: Poison Loss/Poison Acc: {poison_loss:.3f} / '
-                  f'{poison_acc:.3f} |')
-            summary = {"round": rnd, "val_loss": val_loss, "val_acc": val_acc,
-                       "poison_loss": poison_loss, "poison_acc": poison_acc,
-                       "rounds_per_sec": rounds_done / elapsed}
-            # every process calls save: orbax runs cross-process barriers
-            # inside and writes replicated data from the primary only —
-            # lead-gating it would deadlock a multi-host job
-            if cfg.checkpoint_dir:
-                ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
-                          cum_poison_acc, cum_net_mov)
-            if t_steady is None:
-                # first eval boundary done: every program variant on the hot
-                # path has now compiled at least once
-                t_steady = time.perf_counter()
-                rounds_at_steady = rounds_done
-            else:
-                # steady window always ends at a snap boundary: a final
-                # partial segment (rounds % snap != 0) may fall back to the
-                # never-yet-compiled unchained round fn, and that compile
-                # must not pollute the compile-free metric
-                t_steady_end = time.perf_counter()
-                rounds_at_steady_end = rounds_done
-        writer.flush()
+            if rnd % cfg.snap == 0:
+                # divergence aborts only under --debug_nan; otherwise it warns
+                # and the run keeps recording its (NaN) metrics
+                assert_finite_params(params, where=f"round {rnd}",
+                                     raise_error=cfg.debug_nan)
+                val_loss, val_acc, per_class = eval_fn(params, *val)
+                poison_loss, poison_acc, _ = eval_fn(params, *pval)
+                val_loss, val_acc = float(val_loss), float(val_acc)
+                poison_loss, poison_acc = float(poison_loss), float(poison_acc)
+                cum_poison_acc += poison_acc
+                # scalar names preserved from src/federated.py:81-91
+                writer.scalar("Validation/Loss", val_loss, rnd)
+                writer.scalar("Validation/Accuracy", val_acc, rnd)
+                writer.scalar("Poison/Base_Class_Accuracy",
+                              float(per_class[cfg.base_class]), rnd)
+                writer.scalar("Poison/Poison_Accuracy", poison_acc, rnd)
+                writer.scalar("Poison/Poison_Loss", poison_loss, rnd)
+                writer.scalar("Poison/Cumulative_Poison_Accuracy_Mean",
+                              cum_poison_acc / rnd, rnd)
+                writer.scalar("Train/Loss", float(info["train_loss"]), rnd)
+                elapsed = time.perf_counter() - t_loop
+                writer.scalar("Throughput/Rounds_Per_Sec",
+                              rounds_done / elapsed, rnd)
+                if t_steady is not None and rounds_done > rounds_at_steady:
+                    writer.scalar(
+                        "Throughput/Steady_Rounds_Per_Sec",
+                        (rounds_done - rounds_at_steady)
+                        / (time.perf_counter() - t_steady), rnd)
+                print(f'| Rnd {rnd}: Val_Loss/Val_Acc: {val_loss:.3f} / '
+                      f'{val_acc:.3f} |')
+                print(f'| Rnd {rnd}: Poison Loss/Poison Acc: {poison_loss:.3f} / '
+                      f'{poison_acc:.3f} |')
+                summary = {"round": rnd, "val_loss": val_loss, "val_acc": val_acc,
+                           "poison_loss": poison_loss, "poison_acc": poison_acc,
+                           "rounds_per_sec": rounds_done / elapsed}
+                # every process calls save: orbax runs cross-process barriers
+                # inside and writes replicated data from the primary only —
+                # lead-gating it would deadlock a multi-host job
+                if cfg.checkpoint_dir:
+                    ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
+                              cum_poison_acc, cum_net_mov)
+                if t_steady is None:
+                    # first eval boundary done: every program variant on the hot
+                    # path has now compiled at least once
+                    t_steady = time.perf_counter()
+                    rounds_at_steady = rounds_done
+                else:
+                    # steady window always ends at a snap boundary: a final
+                    # partial segment (rounds % snap != 0) may fall back to the
+                    # never-yet-compiled unchained round fn, and that compile
+                    # must not pollute the compile-free metric
+                    t_steady_end = time.perf_counter()
+                    rounds_at_steady_end = rounds_done
+            writer.flush()
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
 
     if cfg.profile_dir and lead:
         jax.profiler.stop_trace()

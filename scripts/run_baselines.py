@@ -164,10 +164,23 @@ def main():
         ]
 
     snap_rounds = [20, 50, 100, R]
+    # --quick is a smoke test of the script: its tiny rows must never mix
+    # into the canonical results file, so it gets its own sidecar files
+    results_path = "results_quick.json" if args.quick else "results.json"
+    if args.quick and args.out == "RESULTS.md":
+        args.out = "RESULTS_quick.md"
+    # merge over the existing rows: a config that fails (or is filtered
+    # out) keeps its previous row instead of erasing it, and a mid-run
+    # crash can't lose completed rows (incremental atomic writes below)
     prior = []
-    if (args.only or args.regen) and os.path.exists("results.json"):
-        with open("results.json") as f:
-            prior = json.load(f)
+    if os.path.exists(results_path):
+        try:
+            with open(results_path) as f:
+                prior = json.load(f)
+        except json.JSONDecodeError:
+            print(f"[baselines] {results_path} is corrupt — starting from "
+                  f"an empty row set", flush=True)
+            prior = []
         for r in prior:   # JSON round-trip stringifies milestone keys
             r["milestones"] = {int(k): v
                                for k, v in r["milestones"].items()}
@@ -178,22 +191,47 @@ def main():
         if not configs:
             sys.exit(f"--only {args.only!r} matches no config "
                      f"(note: --quick builds only the fmnist triple)")
-    results = []
-    for name, cfg in configs:
-        print(f"\n=== {name} ===", flush=True)
-        results.append(run_cfg(name, cfg, snap_rounds))
-        print(json.dumps(results[-1]["summary"]), flush=True)
-
-    ran = {r["name"] for r in results}
-    results = [r for r in prior if r["name"] not in ran] + results
     order = ["fmnist-clean", "fmnist-attack", "fmnist-attack-rlr",
              "cifar10-dba-attack", "cifar10-dba-rlr",
              "cifar10-resnet9-dba-attack", "cifar10-resnet9-dba-rlr",
              "fedemnist-attack", "fedemnist-attack-rlr"]
-    results.sort(key=lambda r: order.index(r["name"])
-                 if r["name"] in order else len(order))
-    with open("results.json", "w") as f:
-        json.dump(results, f, indent=1)
+
+    def merged(new):
+        ran = {r["name"] for r in new}
+        rows = [r for r in prior if r["name"] not in ran] + new
+        rows.sort(key=lambda r: order.index(r["name"])
+                  if r["name"] in order else len(order))
+        return rows
+
+    def write_rows(rows):
+        # atomic: a kill mid-dump must leave the previous file intact, not
+        # a truncated one the next invocation chokes on
+        tmp = results_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rows, f, indent=1)
+        os.replace(tmp, results_path)
+
+    results, failed = [], []
+    for name, cfg in configs:
+        print(f"\n=== {name} ===", flush=True)
+        try:
+            row = run_cfg(name, cfg, snap_rounds)
+        except Exception:
+            # one config dying (e.g. a TPU-tunnel compile hiccup) must not
+            # lose the finished rows or stop the sweep
+            import traceback
+            traceback.print_exc()
+            print(f"[baselines] {name} FAILED — keeping its previous row "
+                  f"if any; continuing with the remaining configs",
+                  flush=True)
+            failed.append(name)
+            continue
+        results.append(row)
+        print(json.dumps(row["summary"]), flush=True)
+        write_rows(merged(results))   # incremental, crash-safe
+
+    results = merged(results)
+    write_rows(results)
 
     device = next((r["device"] for r in results if r.get("device")),
                   "unknown")
@@ -246,7 +284,11 @@ def main():
     ]
     with open(args.out, "w") as f:
         f.write("\n".join(lines))
-    print(f"\nwrote {args.out} and results.json")
+    print(f"\nwrote {args.out} and {results_path}")
+    if failed:
+        sys.exit(f"[baselines] {len(failed)} config(s) failed this "
+                 f"invocation: {', '.join(failed)} — their rows (if any) "
+                 f"are from a previous run")
 
 
 if __name__ == "__main__":

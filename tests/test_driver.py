@@ -47,6 +47,72 @@ def test_driver_host_mode_sharded_matches_single(monkeypatch, capsys):
                                atol=1e-4, rtol=1e-4)
 
 
+def test_driver_host_mode_prefetch_parity(monkeypatch, capsys):
+    """The host->device prefetch pipeline (data/prefetch.py) only moves the
+    gather off the critical path — results must equal the synchronous host
+    gather exactly (same sampling sequence, same device arrays)."""
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    sync = _run(BASE.replace(host_prefetch=0))
+    pre = _run(BASE)  # default: depth-2 prefetch
+    assert "[prefetch] host->device pipeline" in capsys.readouterr().out
+    assert pre["round"] == sync["round"]
+    assert pre["val_acc"] == sync["val_acc"]
+    assert pre["val_loss"] == sync["val_loss"]
+    assert pre["poison_acc"] == sync["poison_acc"]
+
+
+def test_round_prefetcher_order_and_errors():
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+        RoundPrefetcher)
+
+    seen = []
+
+    def produce(r):
+        seen.append(r)
+        return r * 10
+
+    pf = RoundPrefetcher(produce, range(3, 8), depth=2)
+    assert [pf.get(r) for r in range(3, 8)] == [30, 40, 50, 60, 70]
+    # exhausted: asking past the constructed range raises, not hangs
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pf.get(8)
+    pf.close()
+    assert seen == list(range(3, 8))
+
+    def boom(r):
+        if r == 2:
+            raise ValueError("producer died")
+        return r
+
+    pf = RoundPrefetcher(boom, range(1, 5), depth=2)
+    assert pf.get(1) == 1
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        pf.get(2)
+    pf.close()
+
+
+def test_round_prefetcher_error_while_queue_full():
+    """Producer death with a full queue must still surface the error: the
+    sentinel retries until a slot frees instead of being dropped (a dropped
+    sentinel would turn the consumer's next get() into a permanent hang)."""
+    import time
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+        RoundPrefetcher)
+
+    def boom(r):
+        if r == 2:
+            raise ValueError("producer died")
+        return r
+
+    pf = RoundPrefetcher(boom, range(1, 5), depth=1)
+    time.sleep(1.0)  # worker fills the 1-slot queue, then hits the error
+    assert pf.get(1) == 1
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        pf.get(2)
+    pf.close()
+
+
 def test_driver_mesh_device_resident_with_rlr():
     summary = _run(BASE.replace(mesh=0, num_corrupt=2, poison_frac=1.0,
                                 robustLR_threshold=4))

@@ -44,6 +44,39 @@ def test_guard_passes_clean_round():
     assert np.isfinite(float(info["train_loss"]))
 
 
+def test_guard_composes_with_sharded_round():
+    """--debug_nan over the shard_map'd round: checkify must trace through
+    the psum/all_gather collectives on the faked 8-device mesh (ADVICE r1:
+    the sharded guard path was only ever exercised single-device)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+        make_mesh)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+        make_sharded_round_fn)
+
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+    cfg = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
+                 num_corrupt=1, poison_frac=1.0, robustLR_threshold=3,
+                 synth_train_size=256, synth_val_size=64, seed=3)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    mesh = make_mesh(8)
+    sharded = make_sharded_round_fn(
+        cfg, model, norm, mesh, jnp.asarray(fed.train.images),
+        jnp.asarray(fed.train.labels), jnp.asarray(fed.train.sizes))
+    guarded = guard_round_fn(sharded)
+    new_params, info = guarded(params, jax.random.PRNGKey(1))
+    assert np.isfinite(float(info["train_loss"]))
+
+
 def test_assert_finite_params():
     assert assert_finite_params({"a": jnp.ones(3)})
     with pytest.raises(FloatingPointError, match="round 7"):
