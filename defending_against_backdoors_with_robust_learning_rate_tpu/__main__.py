@@ -1,0 +1,8 @@
+"""`python -m defending_against_backdoors_with_robust_learning_rate_tpu`
+— same CLI as `python federated.py` (reference src/runner.sh invocation
+surface) and the installed `rlr-federated` console script."""
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.train import main
+
+if __name__ == "__main__":
+    main()
