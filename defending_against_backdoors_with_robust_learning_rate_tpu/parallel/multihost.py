@@ -84,6 +84,34 @@ def global_agents_mesh(n_devices: int = 0) -> Mesh:
     return Mesh(devices, (AGENTS_AXIS,))
 
 
+def require_pod_divisible(m: int, what: str) -> int:
+    """Global-mesh precondition: the mesh must span every host's devices
+    (each host can only run SPMD programs whose mesh includes its
+    addressable devices), so the per-round participant count has to divide
+    over the full pod. Returns the pod's device count."""
+    n = jax.device_count()
+    if m % n != 0:
+        raise ValueError(
+            f"agents_per_round={m} must be divisible by the pod's {n} "
+            f"devices for a {what} run; adjust --num_agents/--agent_frac")
+    return n
+
+
+def take_agents_sharded(mesh: Mesh, base: np.ndarray, ids: np.ndarray):
+    """`base[ids]` as a global jax.Array sharded over the `agents` axis,
+    WITHOUT materializing the full [m, ...] stack on any host.
+
+    Every process holds the full `base` (replicated seeded data) and the
+    identical `ids`; `jax.make_array_from_callback` asks each process only
+    for its addressable shards, so each host fancy-index-copies just its
+    m/P rows. Correct for any mesh device order (hybrid ICI/DCN
+    included)."""
+    sharding = NamedSharding(mesh, P(AGENTS_AXIS))
+    shape = (len(ids),) + base.shape[1:]
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: base[ids[idx[0]]])
+
+
 def put_replicated(mesh: Mesh, x):
     """Promote (a pytree of) process-local arrays, identical on every host
     (seeded data / init), to fully-replicated global jax.Arrays."""

@@ -85,12 +85,8 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             # over the full pod (global_agents_mesh raises otherwise).
             from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
                 multihost)
-            n_mesh = jax.device_count()
-            if cfg.agents_per_round % n_mesh != 0:
-                raise ValueError(
-                    f"agents_per_round={cfg.agents_per_round} must be "
-                    f"divisible by the pod's {n_mesh} devices for a "
-                    f"multi-host run; adjust --num_agents/--agent_frac")
+            n_mesh = multihost.require_pod_divisible(
+                cfg.agents_per_round, "multi-host")
             mesh = multihost.global_agents_mesh(n_mesh)
             arrays = multihost.put_replicated(
                 mesh, (fed.train.images, fed.train.labels, fed.train.sizes))
@@ -117,12 +113,35 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         if cfg.chain > 1:
             print("[chain] host-sampled mode gathers shards per round; "
                   "--chain request ignored")
-        shard_put = jnp.asarray
+        # take(base, ids) materializes the round's sampled [m, ...] stack
+        # for this mode: the multi-process variant never gathers rows this
+        # process's devices don't own
+        take = lambda a, ids: jnp.asarray(a[ids])  # noqa: E731
         round_fn_host = None
         if cfg.mesh != 1 and jax.process_count() > 1:
-            print("[mesh] host-sampled mode shards over local devices only; "
-                  "multi-process runs are not supported here — --mesh "
-                  "request ignored")
+            # multi-process host-sampled: every process runs the identical
+            # seeded sampling over its (replicated) host dataset, then
+            # materializes only its addressable shards of the global
+            # [m, ...] stacks (multihost.take_agents_sharded); the
+            # shard_mapped round runs over ONE global agents mesh exactly
+            # like the device-resident multi-host path
+            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                multihost)
+            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                make_sharded_round_fn_host)
+            n_mesh = multihost.require_pod_divisible(
+                cfg.agents_per_round, "multi-host host-sampled")
+            mesh = multihost.global_agents_mesh(0)
+            print(f"[mesh] {n_mesh} global devices on the `agents` axis "
+                  f"({cfg.agents_per_round // n_mesh} agents/device), "
+                  f"host-sampled shards, {jax.process_count()} processes")
+            take = lambda a, ids: multihost.take_agents_sharded(mesh, a, ids)  # noqa: E731
+            params = multihost.put_replicated(mesh, params)
+            round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
+                                                       norm, mesh)
+            diag_round_fn_host = (
+                make_sharded_round_fn_host(cfg, model, norm, mesh)
+                if cfg.diagnostics else round_fn_host)
         elif cfg.mesh != 1:
             # the m sampled shards gathered each round are fixed-shape
             # [m, ...] stacks — partition them over the agents mesh (m/d
@@ -141,7 +160,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 agents_sharding = NamedSharding(mesh, P(AGENTS_AXIS))
                 # device_put on the host array splits host->devices in one
                 # step (no staging copy through device 0)
-                shard_put = lambda a: jax.device_put(a, agents_sharding)  # noqa: E731
+                take = lambda a, ids: jax.device_put(a[ids], agents_sharding)  # noqa: E731
                 round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
                                                            norm, mesh)
                 diag_round_fn_host = (
@@ -162,9 +181,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
             ids = rng.choice(cfg.num_agents, cfg.agents_per_round,
                              replace=False)
-            return (ids, shard_put(fed.train.images[ids]),
-                    shard_put(fed.train.labels[ids]),
-                    shard_put(fed.train.sizes[ids]))
+            return (ids, take(fed.train.images, ids),
+                    take(fed.train.labels, ids),
+                    take(fed.train.sizes, ids))
 
         # host gather + H2D transfer overlap the running round program
         # (data/prefetch.py); created lazily at the first round so a resumed
@@ -203,14 +222,14 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     if chained_fn is not None:
         print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan)")
 
-    if jax.process_count() > 1 and not (n_mesh > 1 and not host_mode):
-        # the global-mesh SPMD path was not taken: every process would run
-        # the identical seeded program independently — N-way duplicated
-        # work, not a distributed job (ADVICE r1)
+    if jax.process_count() > 1 and n_mesh <= 1:
+        # no global-mesh SPMD path was taken: every process would run the
+        # identical seeded program independently — N-way duplicated work,
+        # not a distributed job (ADVICE r1)
         print("[WARN] multi-process job without the global agents mesh: "
               f"{jax.process_count()} processes are training REDUNDANTLY. "
-              "Set --mesh=0 (all devices) with a device-resident dataset "
-              "to distribute the round over the pod.")
+              "Set --mesh=0 (all devices) to distribute the round over "
+              "the pod.")
 
     if cfg.debug_nan:
         # sanitizer mode (SURVEY.md section 5.2): float checks compiled into
@@ -433,7 +452,10 @@ def main(argv=None):
             multihost)
         multihost.maybe_initialize(cfg.coordinator, cfg.num_processes,
                                    cfg.process_id)
-    return run(cfg)
+    run(cfg)
+    # entry-point contract: setuptools console scripts wrap this in
+    # sys.exit(main()), so returning the summary dict would exit status 1
+    return 0
 
 
 if __name__ == "__main__":
