@@ -71,6 +71,15 @@ class Config:
     host_sampled: str = "auto"      # auto: shard stacks above the device-
                                     # resident budget (2 GiB) gather on host
                                     # per round; on/off forces the mode
+    agent_chunk: int = 0            # >0: train agents in sequential chunks
+                                    # of this size (lax.map) — divides peak
+                                    # activation HBM by m/chunk for big
+                                    # models; must divide the per-device
+                                    # agent count (else full vmap)
+    remat: bool = False             # blockwise rematerialization of the
+                                    # model's forward (ResNet-9): backward
+                                    # recomputes activations instead of
+                                    # stashing them (exact, saves HBM)
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -202,6 +211,14 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    help="force host-sampled shard gathering on/off "
                         "(auto: stacks above the 2 GiB device-resident "
                         "budget gather on host per round)")
+    p.add_argument("--agent_chunk", type=int, default=d.agent_chunk,
+                   help="train agents in sequential chunks of this size "
+                        "(divides peak activation HBM; must divide the "
+                        "per-device agent count)")
+    p.add_argument("--remat", action="store_true",
+                   help="blockwise rematerialization of the model forward "
+                        "(ResNet-9): recompute activations in backward "
+                        "instead of stashing them — exact, saves HBM")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--log_dir", type=str, default=d.log_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)

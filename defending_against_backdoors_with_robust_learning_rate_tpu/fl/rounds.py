@@ -41,13 +41,54 @@ def _pallas_applicable(cfg) -> bool:
             and cfg.noise == 0 and not cfg.diagnostics)
 
 
+def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
+                chunk: int = 0):
+    """vmap local training over the leading agents axis, optionally in
+    sequential chunks of `chunk` agents (`lax.map` over chunk groups).
+
+    Chunking is the HBM lever for big models: peak activation memory scales
+    with the number of simultaneously-trained agents (40 agents x bs 256 of
+    ResNet-9 stashes ~19 GB — over a v5e chip's 16 GB), so `--agent_chunk c`
+    trades a factor m/c of round latency for a factor m/c of activation
+    memory. Results are independent of the chunking (each agent's training
+    is independent); chunk must divide the (per-device) agent count, else
+    the full vmap runs."""
+    vt = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))
+    m = imgs.shape[0]
+    if chunk <= 0 or chunk >= m or m % chunk != 0:
+        if 0 < chunk < m:
+            # trace-time, prints once per compilation: a silent fallback
+            # would reproduce the exact OOM the flag exists to prevent
+            print(f"[chunk] agent_chunk={chunk} does not divide the agent "
+                  f"block of {m}; running the full vmap (NO activation-"
+                  f"memory savings)")
+        return vt(params, imgs, lbls, sizes, keys)
+    nc = m // chunk
+
+    def resh(a):
+        return a.reshape((nc, chunk) + a.shape[1:])
+
+    def body(carry, args):
+        return carry, vt(params, *args)
+
+    # routed through maybe_unrolled_scan: XLA:CPU executes convs inside
+    # while-loops via a slow reference path (ops/loops.py), so short chunk
+    # loops are traced flat on the CPU backend
+    _, (updates, losses) = loops.maybe_unrolled_scan(
+        body, 0, (resh(imgs), resh(lbls), resh(sizes), resh(keys)),
+        loops.cpu_backend() and nc <= 16)
+    return (jax.tree_util.tree_map(
+        lambda u: u.reshape((m,) + u.shape[2:]), updates),
+        losses.reshape(m))
+
+
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 local_train, cfg):
     """Shared round body: vmapped local training + aggregation + update."""
     m = imgs.shape[0]
     agent_keys = jax.random.split(k_train, m)
-    updates, losses = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
-        params, imgs, lbls, sizes, agent_keys)
+    updates, losses = vmap_agents(local_train, params, imgs, lbls, sizes,
+                                  agent_keys, cfg.agent_chunk)
     if _pallas_applicable(cfg):
         from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
             fused_rlr_avg_apply)
@@ -74,18 +115,24 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     return new_params, jnp.mean(losses), extras
 
 
-def make_chained(step):
-    """Wrap a step(params, key) closure into chained(params, base_key,
+def make_chained(step, data):
+    """Wrap a step(params, key, *data) fn into chained(params, base_key,
     round_ids): a `lax.scan` over rounds, round r keyed by
     `fold_in(base_key, r)` (the driver loop's exact derivation — chained
     blocks match per-round dispatch to ~1 ulp — same ops and keys,
     fusion may round differently). Shared by the
     single-device and sharded paths; info is reduced to the scannable
-    train_loss/sampled leaves."""
+    train_loss/sampled leaves.
+
+    `data` (the K-agent dataset stacks) is bound OUTSIDE the jit and passed
+    as arguments at call time: a jit-closed-over array is inlined into the
+    lowered program as a dense constant — for fedemnist-scale stacks that
+    is a ~0.5 GiB HLO no compile service should (or will) swallow."""
     @functools.partial(jax.jit, donate_argnums=0)
-    def chained(params, base_key, round_ids):
+    def chained(params, base_key, round_ids, *data_args):
         def body(params, rnd):
-            new_params, info = step(params, jax.random.fold_in(base_key, rnd))
+            new_params, info = step(params, jax.random.fold_in(base_key, rnd),
+                                    *data_args)
             return new_params, {"train_loss": info["train_loss"],
                                 "sampled": info["sampled"]}
 
@@ -94,22 +141,31 @@ def make_chained(step):
         py_loops = loops.cpu_backend() and round_ids.shape[0] <= 16
         return loops.maybe_unrolled_scan(body, params, round_ids, py_loops)
 
-    return chained
+    def bound(params, base_key, round_ids):
+        return chained(params, base_key, round_ids, *data)
+
+    bound.jitted, bound.data = chained, data   # for lowering-size tests
+    return bound
 
 
-def _make_sample_step(cfg, model, normalize, images, labels, sizes):
-    """Shared sample-and-step closure: step(params, key) -> (params, info).
+def _make_sample_step(cfg, model, normalize):
+    """Shared sample-and-step fn: step(params, key, images, labels, sizes).
 
     Samples the round's m agents, gathers their device-resident shards
     in-jit, and runs the round core. The key-derivation order (sample, train,
     noise) matches parallel/rounds.py so the sharded and single-device paths
     are comparable round-for-round — and both the per-round and chained fns
-    wrap THIS closure, which is what makes chained execution match
-    per-round dispatch (same ops/keys; ~1 ulp fusion differences)."""
+    wrap THIS fn, which is what makes chained execution match
+    per-round dispatch (same ops/keys; ~1 ulp fusion differences).
+
+    The dataset stacks are ARGUMENTS, not closure captures: jit inlines
+    closed-over arrays into the lowered HLO as dense constants (measured
+    ~1 GiB of StableHLO for the fedemnist stacks, rejected by remote
+    compile services and re-shipped on every compile)."""
     local_train = make_local_train(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
 
-    def step(params, key):
+    def step(params, key, images, labels, sizes):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         sampled = jax.random.permutation(k_sample, K)[:m]
         imgs = jnp.take(images, sampled, axis=0)
@@ -124,13 +180,24 @@ def _make_sample_step(cfg, model, normalize, images, labels, sizes):
     return step
 
 
+def bind_data(step_jit, data):
+    """(params, key, *data) jitted fn -> (params, key) fn with the dataset
+    stacks bound at call time (passed as jit arguments every call; one
+    compilation serves every round since shapes never change)."""
+    def bound(params, key):
+        return step_jit(params, key, *data)
+
+    bound.jitted, bound.data = step_jit, data   # for lowering-size tests
+    return bound
+
+
 def make_round_fn(cfg, model, normalize, images, labels, sizes):
     """Device-resident round fn: round(params, key) -> (params, metrics).
 
     images/labels/sizes are the full K-agent stacked arrays (jnp, on device).
     """
-    return jax.jit(_make_sample_step(cfg, model, normalize,
-                                     images, labels, sizes))
+    return bind_data(jax.jit(_make_sample_step(cfg, model, normalize)),
+                     (images, labels, sizes))
 
 
 def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
@@ -146,8 +213,8 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
     not supported here (the driver runs diagnostic snap rounds unchained).
     """
     return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
-                                          model, normalize,
-                                          images, labels, sizes))
+                                          model, normalize),
+                        (images, labels, sizes))
 
 
 def make_round_fn_host(cfg, model, normalize):

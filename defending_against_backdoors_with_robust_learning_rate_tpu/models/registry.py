@@ -14,12 +14,14 @@ _DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 
 def get_model(data: str, arch: str = "cnn", dtype: str = "f32",
-              n_classes: int = 10):
+              n_classes: int = 10, remat: bool = False):
     """fmnist/fedemnist -> CNN_MNIST; cifar10 -> CNN_CIFAR (src/models.py:4-8);
-    arch='resnet9' selects the BASELINE north-star ResNet-9 extension."""
+    arch='resnet9' selects the BASELINE north-star ResNet-9 extension.
+    `remat` enables blockwise rematerialization (ResNet-9 only; the small
+    CNNs' activations never pressure HBM)."""
     dt = _DTYPES[dtype]
     if arch == "resnet9":
-        return ResNet9(n_classes=n_classes, dtype=dt)
+        return ResNet9(n_classes=n_classes, dtype=dt, remat=remat)
     if data in ("fmnist", "fedemnist", "synthetic"):
         return CNN_MNIST(n_classes=n_classes, dtype=dt)
     if data == "cifar10":

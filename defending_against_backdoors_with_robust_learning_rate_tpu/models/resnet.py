@@ -53,16 +53,27 @@ class Residual(nn.Module):
 class ResNet9(nn.Module):
     n_classes: int = 10
     dtype: Any = jnp.float32
+    # blockwise rematerialization (jax.checkpoint via nn.remat): backward
+    # recomputes each block's activations instead of stashing them — the
+    # standard TPU trade of FLOPs for HBM. Exact (bitwise-equal grads);
+    # needed when many agents' ResNet batches are vmapped on one chip
+    # (40 agents x bs 256 stashes ~19 GB un-remated, > v5e's 16 GB HBM).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
+        Conv = nn.remat(ConvGN) if self.remat else ConvGN
+        Res = nn.remat(Residual) if self.remat else Residual
+        # explicit names: nn.remat prefixes auto-generated module names
+        # ("CheckpointConvGN_0"), which would fork the param tree between
+        # remat on/off — same tree means checkpoints interchange freely
         x = x.astype(self.dtype)
-        x = ConvGN(64, dtype=self.dtype)(x)
-        x = ConvGN(128, pool=True, dtype=self.dtype)(x)
-        x = Residual(128, dtype=self.dtype)(x)
-        x = ConvGN(256, pool=True, dtype=self.dtype)(x)
-        x = ConvGN(512, pool=True, dtype=self.dtype)(x)
-        x = Residual(512, dtype=self.dtype)(x)
+        x = Conv(64, dtype=self.dtype, name="ConvGN_0")(x)
+        x = Conv(128, pool=True, dtype=self.dtype, name="ConvGN_1")(x)
+        x = Res(128, dtype=self.dtype, name="Residual_0")(x)
+        x = Conv(256, pool=True, dtype=self.dtype, name="ConvGN_2")(x)
+        x = Conv(512, pool=True, dtype=self.dtype, name="ConvGN_3")(x)
+        x = Res(512, dtype=self.dtype, name="Residual_1")(x)
         x = jnp.max(x, axis=(1, 2))          # global max pool
         x = nn.Dense(self.n_classes, dtype=self.dtype)(x)
         return (x * 0.125).astype(jnp.float32)

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-    make_chained)
+    bind_data, make_chained, vmap_agents)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
     make_local_train)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
@@ -180,8 +180,9 @@ def _build_sharded_body(cfg, model, normalize, mesh):
     assert m % d == 0, f"agents_per_round={m} not divisible by mesh size {d}"
 
     def shard_body(params, imgs, lbls, szs, keys, noise_key):
-        updates, losses = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
-            params, imgs, lbls, szs, keys)
+        # chunking applies to the per-device agent block (m/d agents)
+        updates, losses = vmap_agents(local_train, params, imgs, lbls, szs,
+                                      keys, cfg.agent_chunk)
         if _pallas_applicable(cfg):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
@@ -218,17 +219,20 @@ def _build_sharded_body(cfg, model, normalize, mesh):
         check_vma=False)
 
 
-def _make_sample_step(cfg, model, normalize, mesh, images, labels, sizes):
-    """Shared sharded sample-and-step closure: step(params, key).
+def _make_sample_step(cfg, model, normalize, mesh):
+    """Shared sharded sample-and-step fn: step(params, key, images, labels,
+    sizes).
 
     Samples the round's m agents, gathers their shards in-jit (partitioned
     over the mesh by shard_map's in_specs), and runs the shard_mapped body.
-    Both the per-round and chained fns wrap THIS closure — chained execution
-    stays bit-identical to per-round dispatch."""
+    Both the per-round and chained fns wrap THIS fn — chained execution
+    stays bit-identical to per-round dispatch. The dataset stacks are jit
+    ARGUMENTS, not closure captures (closure arrays get inlined into the
+    lowered HLO as dense constants — see fl/rounds._make_sample_step)."""
     sharded = _build_sharded_body(cfg, model, normalize, mesh)
     K, m = cfg.num_agents, cfg.agents_per_round
 
-    def step(params, key):
+    def step(params, key, images, labels, sizes):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         sampled = jax.random.permutation(k_sample, K)[:m]
         imgs = jnp.take(images, sampled, axis=0)
@@ -251,8 +255,8 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
     the m sampled shards happens in-jit; the gathered [m, ...] arrays are
     partitioned over the mesh by shard_map's in_specs.
     """
-    return jax.jit(_make_sample_step(cfg, model, normalize, mesh,
-                                     images, labels, sizes))
+    return bind_data(jax.jit(_make_sample_step(cfg, model, normalize, mesh)),
+                     (images, labels, sizes))
 
 
 def make_sharded_round_fn_host(cfg, model, normalize, mesh):
@@ -288,5 +292,5 @@ def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
     (`fold_in(base_key, r)`) matches the driver loop bit-for-bit (see
     fl/rounds.make_chained_round_fn). Diagnostics extras unsupported."""
     return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
-                                          model, normalize, mesh,
-                                          images, labels, sizes))
+                                          model, normalize, mesh),
+                        (images, labels, sizes))

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Root-cause the cifar10-dba-rlr throughput anomaly (VERDICT r1 #3).
+
+Round 1 measured the RLR variant of the cifar DBA config at ~4x fewer
+rounds/sec than the identical shape without the defense; the round-2 rerun
+reproduced it (steady 0.20 vs 1.66 r/s) TOGETHER with a training collapse
+(val_acc -> chance). CPU A/B had already excluded a structural RLR cost.
+This script separates the two remaining hypotheses on the real TPU:
+
+  H1 structural: the thr>0 compiled program is slower per se.
+     -> time the SAME fresh-params block under thr=0 and thr=8.
+  H2 value-dependent: the collapsed parameter values (huge/denormal
+     magnitudes) slow the arithmetic itself, regardless of program.
+     -> evolve params under thr=8 until they degrade, then re-time BOTH
+        programs from those params, and report |param| magnitude stats.
+
+Usage: python scripts/diag_cifar_rlr.py [--platform cpu] [--blocks N]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def timed_block(fn, params, key, ids, reps=3):
+    """Average block time, compile excluded. The chained fn DONATES its
+    params argument, so every call gets its own copy."""
+    import jax
+    import jax.numpy as jnp
+
+    def copy():
+        return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True),
+                                      params)
+
+    jax.block_until_ready(fn(copy(), key, ids)[0])   # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(copy(), key, ids)
+        jax.block_until_ready(out[0])
+    return (time.perf_counter() - t0) / reps, out
+
+
+def mag_stats(params):
+    import jax
+    import numpy as np
+    leaves = [np.asarray(l).ravel() for l in
+              jax.tree_util.tree_leaves(params)]
+    flat = np.concatenate(leaves)
+    a = np.abs(flat)
+    return {
+        "max": float(a.max()),
+        "denormal_frac": float(((a > 0) & (a < 1.18e-38)).mean()),
+        "tiny_frac": float(((a > 0) & (a < 1e-30)).mean()),
+        "nonfinite": int((~np.isfinite(flat)).sum()),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--blocks", type=int, default=6,
+                    help="thr=8 blocks to evolve before re-timing")
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    cfg = Config(data="cifar10", num_agents=40, local_ep=2, bs=256,
+                 num_corrupt=4, poison_frac=0.5, pattern_type="plus",
+                 synth_train_size=50000, synth_val_size=10000,
+                 synth_hardness=0.5, chain=10, seed=0, tensorboard=False,
+                 data_dir="./data")
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params0 = init_params(model, fed.train.images.shape[2:],
+                          jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+
+    fns = {}
+    for thr in (0, 8):
+        fns[thr] = make_chained_round_fn(
+            cfg.replace(robustLR_threshold=thr), model, norm, *arrays)
+
+    key = jax.random.PRNGKey(0)
+    ids = jnp.arange(1, cfg.chain + 1)
+    print(f"[diag] device={jax.devices()[0].device_kind} "
+          f"({jax.default_backend()})", flush=True)
+
+    # H1: fresh params, both programs (first call compiles; timed_block
+    # warmup is the compile)
+    fresh = {}
+    for thr in (0, 8):
+        dt, _ = timed_block(fns[thr], params0, key, ids)
+        fresh[thr] = dt
+        print(f"[diag] fresh-params block (thr={thr}): {dt:.2f}s "
+              f"({cfg.chain / dt:.2f} r/s)", flush=True)
+
+    # evolve under thr=8 (donated params => re-donate each call)
+    params = params0
+    evolved_ids = ids
+    for b in range(args.blocks):
+        params, info = fns[8](params, key, evolved_ids)
+        evolved_ids = evolved_ids + cfg.chain
+        jax.block_until_ready(params)
+    stats = mag_stats(params)
+    print(f"[diag] after {args.blocks * cfg.chain} thr=8 rounds: "
+          f"|param| max={stats['max']:.3e} "
+          f"denormal_frac={stats['denormal_frac']:.4f} "
+          f"tiny_frac={stats['tiny_frac']:.4f} "
+          f"nonfinite={stats['nonfinite']}", flush=True)
+
+    # H2: evolved params, both programs
+    for thr in (0, 8):
+        dt, _ = timed_block(fns[thr], params, key, evolved_ids)
+        print(f"[diag] evolved-params block (thr={thr}): {dt:.2f}s "
+              f"({cfg.chain / dt:.2f} r/s) "
+              f"[vs fresh {fresh[thr]:.2f}s]", flush=True)
+
+
+if __name__ == "__main__":
+    main()

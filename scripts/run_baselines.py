@@ -140,13 +140,18 @@ def main():
                                        pattern_type="plus",
                                        robustLR_threshold=8, **cf)),
             # BASELINE.json configs[3-4]: same DBA shapes on ResNet-9
-            # (VERDICT r1 #7 — the bigger model had never been run)
+            # (VERDICT r1 #7 — the bigger model had never been run).
+            # 40 vmapped agents of ResNet-9 at bs 256 stash ~19 GB of
+            # activations — over a v5e chip's 16 GB HBM (measured OOM at
+            # compile) — so these run with blockwise remat + 10-agent
+            # chunks (both exact; parity-tested)
             ("cifar10-resnet9-dba-attack",
              Config(num_corrupt=4, poison_frac=0.5, pattern_type="plus",
-                    arch="resnet9", **cf)),
+                    arch="resnet9", remat=True, agent_chunk=10, **cf)),
             ("cifar10-resnet9-dba-rlr",
              Config(num_corrupt=4, poison_frac=0.5, pattern_type="plus",
-                    arch="resnet9", robustLR_threshold=8, **cf)),
+                    arch="resnet9", remat=True, agent_chunk=10,
+                    robustLR_threshold=8, **cf)),
         ]
         # fedemnist-shaped non-IID: many agents, partial sampling, deep
         # local training (reference src/runner.sh:34-38: local_ep=10, 10%

@@ -89,3 +89,40 @@ def test_run_with_chain_matches_unchained(tmp_path):
     s2 = run(base.replace(chain=2))
     np.testing.assert_allclose(s1["val_acc"], s2["val_acc"], rtol=1e-5)
     np.testing.assert_allclose(s1["val_loss"], s2["val_loss"], rtol=1e-4)
+
+
+def test_dataset_stacks_are_arguments_not_hlo_constants():
+    """The K-agent dataset stacks must be jit ARGUMENTS: a closed-over array
+    is inlined into the lowered program as a dense constant — ~0.5 GiB of
+    HLO for the fedemnist stacks, which remote compile services reject
+    (observed HTTP 413 from the TPU tunnel) and every compile re-ships."""
+    import jax
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    # ~6.4 MB of image stacks (fmnist geometry, synthetic fallback): far
+    # larger than any legitimate constant
+    cfg = Config(data="fmnist", num_agents=8, bs=16, local_ep=1,
+                 synth_train_size=8192, synth_val_size=32, chain=2, seed=0,
+                 data_dir="/nonexistent_use_synthetic")
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params = init_params(model, cfg.image_shape, jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = tuple(map(jnp.asarray, (fed.train.images, fed.train.labels,
+                                     fed.train.sizes)))
+    assert sum(a.nbytes for a in arrays) > 5_000_000
+    fn = make_chained_round_fn(cfg, model, norm, *arrays)
+    lowered = fn.jitted.lower(params, jax.random.PRNGKey(1),
+                              jnp.arange(1, 3), *fn.data)
+    text_mb = len(lowered.as_text()) / 1e6
+    assert text_mb < 2.0, (
+        f"lowered chained program is {text_mb:.1f} MB of StableHLO — the "
+        f"dataset stacks are being embedded as constants again")

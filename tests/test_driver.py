@@ -113,6 +113,30 @@ def test_round_prefetcher_error_while_queue_full():
     pf.close()
 
 
+def test_driver_agent_chunk_parity():
+    """--agent_chunk trades round latency for peak activation HBM; agents
+    train independently, so chunked results must match the full vmap."""
+    full = _run(BASE)
+    chunked = _run(BASE.replace(agent_chunk=2))
+    assert chunked["round"] == full["round"]
+    np.testing.assert_allclose(chunked["val_acc"], full["val_acc"],
+                               atol=1e-4)
+    np.testing.assert_allclose(chunked["val_loss"], full["val_loss"],
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_driver_agent_chunk_parity_sharded():
+    """Chunking applies per-device on the mesh path (2 agents/device on the
+    8-device mesh, chunk=1 -> 2 sequential chunks per device)."""
+    cfg = BASE.replace(num_agents=16, synth_train_size=512)
+    full = _run(cfg.replace(mesh=0))
+    chunked = _run(cfg.replace(mesh=0, agent_chunk=1))
+    np.testing.assert_allclose(chunked["val_acc"], full["val_acc"],
+                               atol=1e-4)
+    np.testing.assert_allclose(chunked["val_loss"], full["val_loss"],
+                               atol=1e-4, rtol=1e-4)
+
+
 def test_driver_mesh_device_resident_with_rlr():
     summary = _run(BASE.replace(mesh=0, num_corrupt=2, poison_frac=1.0,
                                 robustLR_threshold=4))
