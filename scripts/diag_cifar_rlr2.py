@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Stage 2 of the cifar10-dba-rlr anomaly hunt (VERDICT r1 #3).
+
+diag_cifar_rlr.py established: identical compiled blocks run 5.6s on fresh
+params and ~70s on params evolved by 60 thr=8 rounds — value-dependent
+execution time, with the PARAM values clean (no denormals/inf). This stage
+isolates WHICH component is slow on the evolved values and inspects the
+intermediate values it computes:
+
+  - time one vmapped local-train sweep alone (fresh vs evolved params)
+  - time the server step alone (vote + aggregate + apply) on the updates
+    each sweep produced
+  - value stats (denormal fraction, max/min, nonfinite) for the UPDATES
+    and the per-batch LOGITS under both parameter sets
+
+Usage: python scripts/diag_cifar_rlr2.py [--platform cpu]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def timed(fn, *args, reps=3):
+    import jax
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps, out
+
+
+def val_stats(tree_or_arr, name):
+    import jax
+    import numpy as np
+    leaves = [np.asarray(l).ravel()
+              for l in jax.tree_util.tree_leaves(tree_or_arr)]
+    flat = np.concatenate(leaves)
+    a = np.abs(flat)
+    print(f"[diag2] {name}: max={a.max():.3e} "
+          f"denormal_frac={(((a > 0) & (a < 1.18e-38)).mean()):.4f} "
+          f"nonzero_frac={(a > 0).mean():.4f} "
+          f"nonfinite={int((~np.isfinite(flat)).sum())}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--blocks", type=int, default=6)
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
+        make_local_train)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+        aggregate_updates, apply_aggregate, robust_lr)
+
+    cfg = Config(data="cifar10", num_agents=40, local_ep=2, bs=256,
+                 num_corrupt=4, poison_frac=0.5, pattern_type="plus",
+                 robustLR_threshold=8,
+                 synth_train_size=50000, synth_val_size=10000,
+                 synth_hardness=0.5, chain=10, seed=0, tensorboard=False,
+                 data_dir="./data")
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params0 = init_params(model, fed.train.images.shape[2:],
+                          jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+
+    chained = make_chained_round_fn(cfg, model, norm, *arrays)
+    # chained donates its params argument — evolve from a copy so params0
+    # stays alive for the fresh-params measurements below
+    params = jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True),
+                                    params0)
+    ids = jnp.arange(1, cfg.chain + 1)
+    for b in range(args.blocks):
+        params, _ = chained(params, jax.random.PRNGKey(0), ids)
+        ids = ids + cfg.chain
+    evolved = params
+    jax.block_until_ready(evolved)
+    print(f"[diag2] evolved {args.blocks * cfg.chain} thr=8 rounds", flush=True)
+
+    # one round's worth of sampled shards (fixed, round id 999)
+    local_train = make_local_train(model, cfg, norm)
+    K, m = cfg.num_agents, cfg.agents_per_round
+    key = jax.random.fold_in(jax.random.PRNGKey(0), 999)
+    k_sample, k_train, k_noise = jax.random.split(key, 3)
+    sampled = jax.random.permutation(k_sample, K)[:m]
+    imgs = jnp.take(arrays[0], sampled, axis=0)
+    lbls = jnp.take(arrays[1], sampled, axis=0)
+    szs = jnp.take(arrays[2], sampled, axis=0)
+    agent_keys = jax.random.split(k_train, m)
+
+    sweep = jax.jit(lambda p: jax.vmap(
+        local_train, in_axes=(None, 0, 0, 0, 0))(p, imgs, lbls, szs,
+                                                 agent_keys))
+    fwd = jax.jit(lambda p: model.apply(
+        {"params": p}, norm(imgs[0, :cfg.bs].astype(jnp.float32)),
+        train=False))
+
+    for name, p in (("fresh", params0), ("evolved", evolved)):
+        dt, (updates, losses) = timed(sweep, p)
+        print(f"[diag2] local-train sweep ({name}): {dt:.2f}s", flush=True)
+        val_stats(updates, f"updates ({name})")
+        print(f"[diag2] train_loss ({name}): "
+              f"{float(jnp.mean(losses)):.4f}", flush=True)
+        dtf, logits = timed(fwd, p)
+        print(f"[diag2] fwd one batch ({name}): {dtf * 1e3:.1f} ms",
+              flush=True)
+        val_stats(logits, f"logits ({name})")
+
+        server = jax.jit(lambda p, u: apply_aggregate(
+            p, robust_lr(u, float(cfg.robustLR_threshold),
+                         cfg.effective_server_lr),
+            aggregate_updates(u, szs, cfg, k_noise)))
+        dts, _ = timed(server, p, updates)
+        print(f"[diag2] server step ({name}): {dts * 1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Stage 3: factor matrix for the value-dependent slow chained block.
+
+Stage 2 showed every component (local-train sweep, server step, forward)
+runs at full speed on the evolved params standalone — so the 12x slow
+chained block must depend on a factor beyond "params are evolved".
+Candidates: the round ids fed to the block (61-70 vs 1-10 change every
+per-round PRNG key and sampling permutation) and the param buffer's
+provenance (tunnel-produced vs freshly uploaded). Matrix:
+
+    (fresh params,   ids 1-10)   baseline
+    (fresh params,   ids 61-70)  id effect alone
+    (evolved params, ids 1-10)   param-value effect alone
+    (evolved params, ids 61-70)  the known-slow combination
+    (evolved re-uploaded via host round-trip, ids 61-70)  buffer provenance
+
+Usage: python scripts/diag_cifar_rlr3.py [--platform cpu]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--blocks", type=int, default=6)
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    cfg = Config(data="cifar10", num_agents=40, local_ep=2, bs=256,
+                 num_corrupt=4, poison_frac=0.5, pattern_type="plus",
+                 robustLR_threshold=8,
+                 synth_train_size=50000, synth_val_size=10000,
+                 synth_hardness=0.5, chain=10, seed=0, tensorboard=False,
+                 data_dir="./data")
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    params0 = init_params(model, fed.train.images.shape[2:],
+                          jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+    chained = make_chained_round_fn(cfg, model, norm, *arrays)
+    key = jax.random.PRNGKey(0)
+
+    def copy(p):
+        return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), p)
+
+    def t_block(p, lo, reps=2):
+        ids = jnp.arange(lo, lo + cfg.chain)
+        jax.block_until_ready(chained(copy(p), key, ids)[0])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = chained(copy(p), key, ids)
+            jax.block_until_ready(out[0])
+        return (time.perf_counter() - t0) / reps
+
+    params = copy(params0)
+    t_evolve0 = time.perf_counter()
+    for b in range(args.blocks):
+        params, _ = chained(params, key,
+                            jnp.arange(b * 10 + 1, b * 10 + 11))
+        jax.block_until_ready(params)
+    print(f"[diag3] evolution: {args.blocks} blocks in "
+          f"{time.perf_counter() - t_evolve0:.1f}s", flush=True)
+    evolved = params
+
+    # host round-trip re-upload of the evolved params (fresh device buffers
+    # with identical values)
+    reup = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)), evolved)
+
+    for name, p, lo in (("fresh/ids1", params0, 1),
+                        ("fresh/ids61", params0, 61),
+                        ("evolved/ids1", evolved, 1),
+                        ("evolved/ids61", evolved, 61),
+                        ("reupload/ids61", reup, 61)):
+        dt = t_block(p, lo)
+        print(f"[diag3] block {name}: {dt:.2f}s "
+              f"({cfg.chain / dt:.2f} r/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

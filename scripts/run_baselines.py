@@ -83,9 +83,17 @@ def main():
                     help="rewrite RESULTS.md from the existing results.json "
                          "without running anything (no backend touched)")
     ap.add_argument("--hardness", type=float, default=0.5,
-                    help="synth_hardness for every config (VERDICT r1 #4: "
-                         "at 0 the task saturates val_acc=1.0 by round 20 "
-                         "and the curves are vacuous)")
+                    help="fmnist synth_hardness (VERDICT r1 #4: at 0 the "
+                         "task saturates val_acc=1.0 by round 20 and the "
+                         "curves are vacuous)")
+    # per-dataset hardness: the RLR threshold (8 votes) needs early-round
+    # sign agreement to exceed chance; at hardness 0.5 the 40-agent cifar
+    # CNN and the 32-sampled fedemnist configs sit below that bar and the
+    # defense's -lr flips prevent training from ever starting (measured:
+    # val stuck at 0.093/0.116). These defaults give non-trivial curves
+    # where training survives the defense — the paper's regime.
+    ap.add_argument("--hardness_cifar", type=float, default=0.25)
+    ap.add_argument("--hardness_fedemnist", type=float, default=0.3)
     ap.add_argument("--platform", default="",
                     help="force a jax platform (e.g. cpu when the TPU "
                          "tunnel is wedged); must land before backend init")
@@ -129,9 +137,9 @@ def main():
         # thr=8) — scaled rounds; ResNet-9 is the BASELINE.json configs[3]
         # arch, the faithful CNN_CIFAR is cfg.arch='cnn'
         cf = dict(data="cifar10", num_agents=40, local_ep=2, bs=256,
-                  rounds=min(R, 100), snap=snap, chain=chain, seed=0,
+                  rounds=min(R, 150), snap=snap, chain=chain, seed=0,
                   synth_train_size=50000, synth_val_size=10000,
-                  synth_hardness=args.hardness,
+                  synth_hardness=args.hardness_cifar,
                   tensorboard=False, data_dir="./data")
         configs += [
             ("cifar10-dba-attack", Config(num_corrupt=4, poison_frac=0.5,
@@ -159,7 +167,8 @@ def main():
         fe = dict(data="fedemnist", num_agents=128, agent_frac=0.25,
                   local_ep=10, bs=64, rounds=min(R, 100), snap=snap,
                   chain=chain, seed=0, synth_train_size=32768,
-                  synth_val_size=1024, synth_hardness=args.hardness,
+                  synth_val_size=1024,
+                  synth_hardness=args.hardness_fedemnist,
                   tensorboard=False, data_dir="./data")
         configs += [
             ("fedemnist-attack", Config(num_corrupt=13, poison_frac=0.5,
@@ -262,6 +271,18 @@ def main():
         f"({chain} rounds/XLA program). Synthetic-task hardness per row "
         "is recorded in results.json (`hardness`); rows at different "
         "hardness are not comparable.",
+        "",
+        "Hardness is tuned PER DATASET (fmnist 0.5, cifar10 0.25, "
+        "fedemnist 0.3): the RLR defense flips the server lr negative on "
+        "coordinates below the vote threshold, so it needs early-round "
+        "sign agreement above chance to let training start at all. At "
+        "hardness 0.5 the 40-agent cifar CNN and 32-sampled fedemnist "
+        "configs sit below that bar and the defense collapses training "
+        "(val stuck at chance) — a real property of the defense/task "
+        "pair, not of the framework; the tuned values put each dataset "
+        "in the paper's regime (training survives the defense, curves "
+        "stay non-trivial). ResNet-9 clears the bar even at 0.5. "
+        "Throughput investigation notes: BENCH_NOTES.md.",
         "",
         "| config | rounds | val acc | poison acc | val@20 | poison@20 |"
         " r/s (wall) | r/s (steady) | wall |",

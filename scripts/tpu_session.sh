@@ -17,12 +17,14 @@ if ! timeout 45 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1; then
 fi
 say "TPU alive"
 
-say "step 1/4: materialize real-format dataset files (hardness 0.5)"
-python scripts/make_dataset_files.py --data_dir=./data --hardness=0.5 \
+say "step 1/4: materialize real-format dataset files (per-dataset hardness)"
+{ python scripts/make_dataset_files.py --data_dir=./data --only fmnist --hardness=0.5 &&
+  python scripts/make_dataset_files.py --data_dir=./data --only cifar10 --hardness=0.25 &&
+  python scripts/make_dataset_files.py --data_dir=./data --only fedemnist --hardness=0.3; } \
     >>"$LOG" 2>&1 || say "WARN: make_dataset_files failed (runs will use the in-memory fallback)"
 
 say "step 2/4: full baselines regen (9 configs incl. ResNet-9)"
-python scripts/run_baselines.py --hardness=0.5 >>"$LOG" 2>&1 \
+python scripts/run_baselines.py >>"$LOG" 2>&1 \
     && say "baselines done" || say "WARN: run_baselines rc=$?"
 
 say "step 3/4: regenerate curve figures"
