@@ -44,7 +44,11 @@ def maybe_unrolled_scan(body, init, xs, python_mode: bool):
     elif mode == "python":
         python_mode = True
     if not python_mode:
-        return jax.lax.scan(body, init, xs)
+        # RLR_SCAN_UNROLL=n replicates the scan body n times per while-loop
+        # iteration (XLA unroll) — an A/B knob for TPU loop overhead;
+        # results are identical, only fusion scope changes
+        unroll = int(os.environ.get("RLR_SCAN_UNROLL", "1"))
+        return jax.lax.scan(body, init, xs, unroll=unroll)
 
     length = jax.tree_util.tree_leaves(xs)[0].shape[0]
     carry = init
