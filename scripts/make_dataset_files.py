@@ -103,10 +103,21 @@ def make_fedemnist(data_dir, n_train, n_val, n_users, seed, hardness):
 
     torch.save(to_pt(va.images, va.labels),
                os.path.join(base, "fed_emnist_all_valset.pt"))
-    # non-IID-ish unequal user sizes, like the LEAF per-writer shards
+    # unequal user sizes with LEAF-like moderate skew (gamma weights,
+    # cv~0.35 — real per-writer EMNIST shards are ~100-400 samples, never
+    # single digits). Uniform random cuts (the previous scheme) produce
+    # sizes from 2 to ~5x the mean: 80% of the padded stack is padding,
+    # and the extreme shards make the 10-local-epoch FedAvg dynamics
+    # knife-edge chaotic (measured: trains at hardness 0.5, collapses to
+    # chance at 0.3).
     rng = np.random.default_rng(seed + 11)
-    cuts = np.sort(rng.choice(np.arange(1, n_train), n_users - 1,
-                              replace=False))
+    w = rng.gamma(8.0, size=n_users)
+    sizes = np.maximum(1, np.floor(n_train * w / w.sum())).astype(int)
+    while sizes.sum() > n_train:
+        sizes[np.argmax(sizes)] -= 1
+    while sizes.sum() < n_train:
+        sizes[np.argmin(sizes)] += 1
+    cuts = np.cumsum(sizes)[:-1]
     order = rng.permutation(n_train)
     for uid, idxs in enumerate(np.split(order, cuts)):
         torch.save(to_pt(tr.images[idxs], tr.labels[idxs]),

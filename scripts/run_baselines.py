@@ -93,7 +93,7 @@ def main():
     # val stuck at 0.093/0.116). These defaults give non-trivial curves
     # where training survives the defense — the paper's regime.
     ap.add_argument("--hardness_cifar", type=float, default=0.25)
-    ap.add_argument("--hardness_fedemnist", type=float, default=0.3)
+    ap.add_argument("--hardness_fedemnist", type=float, default=0.4)
     ap.add_argument("--platform", default="",
                     help="force a jax platform (e.g. cpu when the TPU "
                          "tunnel is wedged); must land before backend init")
@@ -273,7 +273,7 @@ def main():
         "hardness are not comparable.",
         "",
         "Hardness is tuned PER DATASET (fmnist 0.5, cifar10 0.25, "
-        "fedemnist 0.3): the RLR defense flips the server lr negative on "
+        "fedemnist 0.4): the RLR defense flips the server lr negative on "
         "coordinates below the vote threshold, so it needs early-round "
         "sign agreement above chance to let training start at all. At "
         "hardness 0.5 the 40-agent cifar CNN and 32-sampled fedemnist "

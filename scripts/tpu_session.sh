@@ -20,7 +20,7 @@ say "TPU alive"
 say "step 1/4: materialize real-format dataset files (per-dataset hardness)"
 { python scripts/make_dataset_files.py --data_dir=./data --only fmnist --hardness=0.5 &&
   python scripts/make_dataset_files.py --data_dir=./data --only cifar10 --hardness=0.25 &&
-  python scripts/make_dataset_files.py --data_dir=./data --only fedemnist --hardness=0.3; } \
+  python scripts/make_dataset_files.py --data_dir=./data --only fedemnist --hardness=0.4; } \
     >>"$LOG" 2>&1 || say "WARN: make_dataset_files failed (runs will use the in-memory fallback)"
 
 say "step 2/4: full baselines regen (9 configs incl. ResNet-9)"
