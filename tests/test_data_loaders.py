@@ -209,3 +209,30 @@ def test_make_dataset_files_roundtrip_cifar_fedemnist(tmp_path):
     assert sum(len(y) for _, y in shards) == 100
     assert val.images.shape == (20, 28, 28, 1)
     assert val.images.dtype == np.float32
+
+
+def test_fedemnist_user_sizes_bounded_skew(tmp_path):
+    """The .pt user shards use LEAF-like gamma-weighted sizes: they must
+    sum exactly to n_train, have no degenerate tiny users, and stay within
+    a moderate spread (the old uniform-cut scheme produced sizes 2..5x the
+    mean — 80% padding and knife-edge FedAvg dynamics)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", "scripts"))
+    from make_dataset_files import make_fedemnist
+
+    make_fedemnist(str(tmp_path), n_train=4096, n_val=64, n_users=32,
+                   seed=0, hardness=0.3)
+    import torch
+    sizes = []
+    for uid in range(32):
+        x, y = torch.load(os.path.join(
+            str(tmp_path), "Fed_EMNIST", "user_trainsets",
+            f"user_{uid}_trainset.pt"), weights_only=False)
+        assert x.shape[0] == y.shape[0]
+        sizes.append(x.shape[0])
+    sizes = np.array(sizes)
+    assert sizes.sum() == 4096
+    mean = sizes.mean()
+    assert sizes.min() >= mean * 0.3, sizes.min()
+    assert sizes.max() <= mean * 2.5, sizes.max()

@@ -35,7 +35,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics imp
     NullWriter)
 cfg = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
              synth_train_size=256, synth_val_size=64, eval_bs=64,
-             rounds=2, snap=2, seed=5, mesh=0, chain=1,
+             rounds=2, snap=2, seed=5, mesh=0, chain=2,
              num_corrupt=1, poison_frac=1.0, robustLR_threshold=3,
              checkpoint_dir=ckpt_dir, tensorboard=False)
 summary = train.run(cfg, writer=NullWriter())
