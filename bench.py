@@ -141,7 +141,7 @@ def main():
     log(f"[bench] devices: {jax.devices()}")
 
     fed = get_federated_data(cfg)
-    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat)
     params = init_params(model, fed.train.images.shape[2:],
                          jax.random.PRNGKey(0))
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
