@@ -34,6 +34,12 @@ def distribute_data(labels: np.ndarray, num_agents: int,
 
     # split each class's indices into `slice_size` strided chunks
     shard_size = n // (num_agents * class_per_agent)
+    if shard_size == 0:
+        raise ValueError(
+            f"dataset too small to partition: {n} samples cannot give "
+            f"{num_agents} agents x {class_per_agent} class-shards each "
+            f"(need >= {num_agents * class_per_agent}). The reference's "
+            f"dealing scheme (src/utils.py:58-92) has the same bound.")
     slice_size = (n // n_classes) // shard_size
     for k, v in per_class.items():
         labels_dict[k] = [v[i::slice_size] for i in range(slice_size)]

@@ -76,7 +76,7 @@ int32_t fl_distribute_data(const int32_t* labels, int64_t n, int32_t num_agents,
     return kOk;
   }
   int64_t shard_size = n / (int64_t(num_agents) * class_per_agent);
-  if (shard_size == 0) return kErrBadArg;  // Python raises ZeroDivisionError
+  if (shard_size == 0) return kErrBadArg;  // Python raises ValueError
   int64_t slice_size = (n / n_classes) / shard_size;
   if (slice_size == 0) return kErrBadArg;
 

@@ -137,6 +137,26 @@ def test_driver_agent_chunk_parity_sharded():
                                atol=1e-4, rtol=1e-4)
 
 
+def test_driver_256_agent_krum_on_mesh():
+    """BASELINE configs[4] shape scaled to CI: 256 agents (32/device on the
+    faked 8-device mesh), 10% corrupt, krum aggregation via the
+    param-sharded all_to_all path."""
+    cfg = BASE.replace(num_agents=256, bs=8, synth_train_size=8192,
+                       synth_val_size=128, rounds=2, snap=2, mesh=0,
+                       aggr="krum", num_corrupt=26, poison_frac=1.0)
+    summary = _run(cfg)
+    assert summary["round"] == 2
+    assert np.isfinite(summary["val_acc"])
+
+
+def test_partitioner_too_small_dataset_raises():
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.partition import (
+        distribute_data)
+    labels = np.arange(10).repeat(10)   # 100 samples
+    with pytest.raises(ValueError, match="dataset too small"):
+        distribute_data(labels, num_agents=256)
+
+
 def test_driver_mesh_device_resident_with_rlr():
     summary = _run(BASE.replace(mesh=0, num_corrupt=2, poison_frac=1.0,
                                 robustLR_threshold=4))
