@@ -8,10 +8,12 @@ numeric regeneration the first build milestone. This script runs the
 canonical experiment shapes (reference src/runner.sh:12-38) and writes
 RESULTS.md + results.json.
 
-Real FMNIST/CIFAR-10 are not downloadable in this environment (zero egress);
-runs use the deterministic synthetic fallback with the real datasets'
-geometry (documented in RESULTS.md). The qualitative claims being checked
-are data-agnostic: training learns, the backdoor succeeds undefended, RLR
+Real FMNIST/CIFAR-10 are not downloadable in this environment (zero
+egress); scripts/make_dataset_files.py materializes the deterministic
+synthetic task into the REAL on-disk formats (FMNIST IDX, CIFAR pickle
+batches, Fed-EMNIST per-user .pt shards), so every run exercises the
+production parsers end-to-end. The qualitative claims being checked are
+data-agnostic: training learns, the backdoor succeeds undefended, RLR
 collapses it at small clean-accuracy cost.
 
 Usage: python scripts/run_baselines.py [--rounds N] [--quick]
@@ -317,10 +319,13 @@ def main():
         "The reference publishes **no numeric baseline** (SURVEY.md "
         "section 6): only two curve screenshots and prose. This table "
         "regenerates it numerically with this framework. Real "
-        "FMNIST/CIFAR-10 cannot be downloaded in this environment; runs "
-        "use the deterministic synthetic fallback with the real datasets' "
-        "geometry (60k x 28x28x1 / 50k x 32x32x3), so absolute accuracies "
-        "are not comparable to the paper — the **qualitative claims** "
+        "FMNIST/CIFAR-10 cannot be downloaded in this environment; "
+        "`scripts/make_dataset_files.py` writes the deterministic "
+        "synthetic task into the REAL dataset file formats (FMNIST IDX, "
+        "CIFAR pickle batches, Fed-EMNIST per-user `.pt` shards; 60k x "
+        "28x28x1 / 50k x 32x32x3), so every run loads data through the "
+        "production parsers. Absolute accuracies are not comparable to "
+        "the paper — the **qualitative claims** "
         "(reference README.md:30-34) are what is being checked:",
         "",
         "1. training learns (clean val accuracy rises),",
