@@ -137,6 +137,10 @@ def main():
     ap.add_argument("--hardness", type=float, default=0.5)
     ap.add_argument("--only", default="",
                     help="substring filter: fmnist|cifar10|fedemnist")
+    ap.add_argument("--fedemnist_train", type=int, default=0,
+                    help="fedemnist total sample override (0 = "
+                         "min(--train, 32768)); use with --users 3383 for "
+                         "the full-scale host-sampled set")
     args = ap.parse_args()
 
     if not args.only or "fmnist" in args.only:
@@ -146,7 +150,10 @@ def main():
         make_cifar10(args.data_dir, 50000 if args.train == 60000
                      else args.train, args.val, args.seed, args.hardness)
     if not args.only or "fedemnist" in args.only:
-        n_tr = min(args.train, 32768)
+        # the fmnist-oriented --train default (60000) is capped to the
+        # canonical 32768 fedemnist total; an explicit --fedemnist_train
+        # overrides (e.g. the full-scale 3383-user set)
+        n_tr = args.fedemnist_train or min(args.train, 32768)
         make_fedemnist(args.data_dir, n_tr, min(args.val, 1024),
                        min(args.users, n_tr), args.seed, args.hardness)
 
