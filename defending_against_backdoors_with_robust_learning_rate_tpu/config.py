@@ -65,9 +65,13 @@ class Config:
     chain: int = 1                  # rounds fused per dispatch via lax.scan
                                     # (capped at `snap`; >1 kills per-round
                                     # host dispatch overhead, bit-identical)
-    host_prefetch: int = 2          # host-sampled mode: rounds of shard
-                                    # stacks gathered + device_put ahead of
-                                    # the compute (0 = synchronous gather)
+    host_prefetch: int = 2          # host-sampled mode: dispatch UNITS of
+                                    # shard stacks gathered + device_put
+                                    # ahead of the compute (0 = synchronous;
+                                    # a unit is one round, or `chain` rounds
+                                    # when chained — up to N+1 units
+                                    # resident: N queued + 1 in the
+                                    # worker's hand)
     host_sampled: str = "auto"      # auto: shard stacks above the device-
                                     # resident budget (2 GiB) gather on host
                                     # per round; on/off forces the mode
@@ -204,8 +208,11 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    help="rounds fused into one compiled lax.scan dispatch "
                         "(capped at --snap so eval cadence is unchanged)")
     p.add_argument("--host_prefetch", type=int, default=d.host_prefetch,
-                   help="host-sampled mode: rounds of shard stacks gathered "
-                        "+ device_put ahead of the compute (0=synchronous)")
+                   help="host-sampled mode: dispatch units (1 round, or "
+                        "--chain rounds when chained) of shard stacks "
+                        "gathered + device_put ahead of the compute "
+                        "(0=synchronous; device memory holds up to N+1 "
+                        "units in flight)")
     p.add_argument("--host_sampled", choices=("auto", "on", "off"),
                    default=d.host_sampled,
                    help="force host-sampled shard gathering on/off "

@@ -33,7 +33,19 @@ class RoundPrefetcher:
 
     produce(rnd) -> payload is called on a worker thread for each round id in
     `rounds`, in order; `get(rnd)` returns the payloads in the same order.
-    A producer exception is re-raised by the next `get` call."""
+    A producer exception is re-raised by the next `get` call.
+
+    Memory note: effective pipeline depth is `depth + 1` payloads resident
+    at once — the queue holds `depth` plus one in the worker's hand mid-put.
+    Callers sizing device memory against `--host_prefetch N` should budget
+    N+1 payloads; a payload is one dispatch UNIT — a single round's [m, ...]
+    stacks, or a whole [chain, m, ...] block in chained host mode
+    (documented in the flag help too)."""
+
+    # get() re-checks for a wedged worker at this period, and logs a
+    # heartbeat so a hang (e.g. a stuck device_put through a TPU tunnel) is
+    # attributable to the pipeline rather than silently blocking the driver
+    STALL_WARN_SEC = 30.0
 
     def __init__(self, produce: Callable, rounds: Iterable[int],
                  depth: int = 2):
@@ -74,8 +86,24 @@ class RoundPrefetcher:
 
     def get(self, rnd: int):
         """Blocking fetch of round `rnd`'s payload (calls must follow the
-        constructor's round order)."""
-        item = self._q.get()
+        constructor's round order). Never hangs silently: while waiting it
+        logs a stall heartbeat every STALL_WARN_SEC so a wedged produce()
+        (hung host gather / device_put) is attributable."""
+        waited = 0.0
+        while True:
+            try:
+                item = self._q.get(timeout=self.STALL_WARN_SEC)
+                break
+            except queue.Empty:
+                waited += self.STALL_WARN_SEC
+                alive = self._thread.is_alive()
+                print(f"[prefetch] stalled waiting for round {rnd} "
+                      f"({waited:.0f}s; worker "
+                      f"{'alive' if alive else 'DEAD'})", flush=True)
+                if not alive and self._q.empty():
+                    raise RuntimeError(
+                        f"prefetch worker died without sentinel before "
+                        f"round {rnd}") from self._err
         if item is _SENTINEL:
             if self._err is not None:
                 raise RuntimeError(

@@ -27,6 +27,27 @@ import jax
 import jax.numpy as jnp
 
 
+def _parse_scan_unroll() -> int:
+    """RLR_SCAN_UNROLL=n replicates the scan body n times per while-loop
+    iteration (XLA unroll) — an A/B knob for TPU loop overhead; results are
+    identical, only fusion scope changes. It applies to EVERY
+    maybe_unrolled_scan call site (local-epoch loop, chained-round scan,
+    agent-chunk loop), not just the round scan. Parsed once at import so a
+    malformed value fails loudly here, not deep inside a jit trace."""
+    raw = os.environ.get("RLR_SCAN_UNROLL", "1")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"RLR_SCAN_UNROLL must be an integer, got {raw!r}") from None
+    if n < 1:
+        raise ValueError(f"RLR_SCAN_UNROLL must be >= 1, got {n}")
+    return n
+
+
+_SCAN_UNROLL = _parse_scan_unroll()
+
+
 def cpu_backend() -> bool:
     return jax.default_backend() == "cpu"
 
@@ -44,11 +65,7 @@ def maybe_unrolled_scan(body, init, xs, python_mode: bool):
     elif mode == "python":
         python_mode = True
     if not python_mode:
-        # RLR_SCAN_UNROLL=n replicates the scan body n times per while-loop
-        # iteration (XLA unroll) — an A/B knob for TPU loop overhead;
-        # results are identical, only fusion scope changes
-        unroll = int(os.environ.get("RLR_SCAN_UNROLL", "1"))
-        return jax.lax.scan(body, init, xs, unroll=unroll)
+        return jax.lax.scan(body, init, xs, unroll=_SCAN_UNROLL)
 
     length = jax.tree_util.tree_leaves(xs)[0].shape[0]
     carry = init
