@@ -55,13 +55,13 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
     the full vmap runs."""
     vt = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))
     m = imgs.shape[0]
-    if chunk <= 0 or chunk >= m or m % chunk != 0:
-        if 0 < chunk < m:
-            # trace-time, prints once per compilation: a silent fallback
-            # would reproduce the exact OOM the flag exists to prevent
-            print(f"[chunk] agent_chunk={chunk} does not divide the agent "
-                  f"block of {m}; running the full vmap (NO activation-"
-                  f"memory savings)")
+    if 0 < chunk < m and m % chunk != 0:
+        # falling back to the full vmap would reproduce the exact
+        # compile-time OOM this flag exists to prevent — fail loudly
+        raise ValueError(
+            f"--agent_chunk {chunk} does not divide the agent block of {m} "
+            f"(per-device agent count); pick a divisor or 0 for full vmap")
+    if chunk <= 0 or chunk >= m:
         return vt(params, imgs, lbls, sizes, keys)
     nc = m // chunk
 
@@ -217,19 +217,61 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
                         (images, labels, sizes))
 
 
-def make_round_fn_host(cfg, model, normalize):
-    """Host-sampled round fn: round(params, key, imgs, lbls, sizes).
-
-    The driver samples agent ids and gathers their shards host-side (the
-    fedemnist path: 3383 users, 1% sampled per round, src/runner.sh:34)."""
+def make_host_step(cfg, model, normalize):
+    """Unjitted host-sampled step(params, key, imgs, lbls, sizes) — the
+    shared body of the per-round and chained host fns (key split into
+    k_train/k_noise matches bit-for-bit between them)."""
     local_train = make_local_train(model, cfg, normalize)
 
-    @jax.jit
-    def round_fn(params, key, imgs, lbls, sizes):
+    def step(params, key, imgs, lbls, sizes):
         k_train, k_noise = jax.random.split(key)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, sizes,
             local_train=local_train, cfg=cfg)
         return new_params, {"train_loss": train_loss, **extras}
 
-    return round_fn
+    return step
+
+
+def make_round_fn_host(cfg, model, normalize):
+    """Host-sampled round fn: round(params, key, imgs, lbls, sizes).
+
+    The driver samples agent ids and gathers their shards host-side (the
+    fedemnist path: 3383 users, 1% sampled per round, src/runner.sh:34)."""
+    return jax.jit(make_host_step(cfg, model, normalize))
+
+
+def make_chained_host(step):
+    """Wrap an unjitted host step into chained(params, base_key, round_ids,
+    imgs, lbls, sizes) over [chain, m, ...] shard-stack blocks: a `lax.scan`
+    whose round r consumes block row r and key `fold_in(base_key, r)` — the
+    driver loop's exact derivation, so a chained host block matches
+    dispatching the same rounds one at a time (~1 ulp fusion differences).
+
+    This lifts the r2 restriction that host-sampled mode pays one host
+    dispatch + gather per round (the fedemnist-scale path, ref
+    src/runner.sh:34-38 at 500 rounds): the driver prefetches a whole
+    block's shard stacks and the TPU runs `chain` rounds per dispatch.
+    Shared by the single-device and sharded host paths."""
+    @functools.partial(jax.jit, donate_argnums=0)
+    def chained(params, base_key, round_ids, imgs, lbls, sizes):
+        def body(params, xs):
+            rnd, im, lb, sz = xs
+            new_params, info = step(
+                params, jax.random.fold_in(base_key, rnd), im, lb, sz)
+            return new_params, {"train_loss": info["train_loss"]}
+
+        # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short chains
+        py_loops = loops.cpu_backend() and round_ids.shape[0] <= 16
+        return loops.maybe_unrolled_scan(
+            body, params, (round_ids, imgs, lbls, sizes), py_loops)
+
+    return chained
+
+
+def make_chained_round_fn_host(cfg, model, normalize):
+    """Chained host-sampled rounds: chained(params, base_key, round_ids,
+    imgs, lbls, sizes) with [chain, m, ...] blocks (diagnostics unsupported;
+    the driver runs diagnostic snap rounds unchained)."""
+    return make_chained_host(
+        make_host_step(cfg.replace(diagnostics=False), model, normalize))

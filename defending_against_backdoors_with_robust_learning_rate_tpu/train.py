@@ -43,6 +43,28 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics imp
 DEVICE_RESIDENT_BYTES = 2 << 30
 
 
+def dispatch_schedule(start, total, snap, chain_n, diagnostics, chaining):
+    """The driver's dispatch plan: a list of round-id tuples, one per
+    dispatch — a chained block (len == chain_n) whenever the budget to the
+    next eval boundary allows, else a single round. A chained block never
+    crosses an eval boundary, and a diagnostics run keeps its snap rounds
+    unchained (they need prev_params + the diag-compiled variant). This is
+    the SINGLE source of truth: the run loop iterates these units directly
+    and the host-mode prefetcher produces payloads against the same list."""
+    units, rnd = [], start
+    while rnd < total:
+        to_eval = min(snap - rnd % snap, total - rnd)
+        diag_boundary = diagnostics and (rnd + to_eval) % snap == 0
+        budget = to_eval - (1 if diag_boundary else 0)
+        if chaining and budget >= chain_n:
+            units.append(tuple(range(rnd + 1, rnd + chain_n + 1)))
+            rnd += chain_n
+        else:
+            units.append((rnd + 1,))
+            rnd += 1
+    return units
+
+
 def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     print_exp_details(cfg)
     fed = get_federated_data(cfg)
@@ -72,6 +94,8 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     plain_cfg = cfg.replace(diagnostics=False)
     host_sampler = None
     chained_fn = None
+    host_chained_fn = None
+    get_unit = None     # host-mode payload fetch, defined in the host branch
     prefetcher = None   # host-mode RoundPrefetcher, created lazily
     # a diagnostic snap round always runs unchained, so it is excluded from
     # the per-boundary chain budget
@@ -110,13 +134,12 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     elif host_mode:
         print(f"[data] host-sampled mode "
               f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
-        if cfg.chain > 1:
-            print("[chain] host-sampled mode gathers shards per round; "
-                  "--chain request ignored")
         # take(base, ids) materializes the round's sampled [m, ...] stack
         # for this mode: the multi-process variant never gathers rows this
-        # process's devices don't own
+        # process's devices don't own. take_block is the chained variant:
+        # ids [chain, m] -> [chain, m, ...] block in one placement.
         take = lambda a, ids: jnp.asarray(a[ids])  # noqa: E731
+        take_block = take
         round_fn_host = None
         if cfg.mesh != 1 and jax.process_count() > 1:
             # multi-process host-sampled: every process runs the identical
@@ -137,6 +160,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                   f"host-sampled shards, {jax.process_count()} processes")
             take = lambda a, ids: multihost.take_agents_sharded(mesh, a, ids)  # noqa: E731
             params = multihost.put_replicated(mesh, params)
+            if cfg.chain > 1:
+                print("[chain] multi-process host-sampled gathers are "
+                      "per-round (take_agents_sharded); --chain ignored")
             round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
                                                        norm, mesh)
             diag_round_fn_host = (
@@ -158,14 +184,22 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                       f"({cfg.agents_per_round // n_mesh} agents/device), "
                       f"host-sampled shards")
                 agents_sharding = NamedSharding(mesh, P(AGENTS_AXIS))
+                block_sharding = NamedSharding(mesh, P(None, AGENTS_AXIS))
                 # device_put on the host array splits host->devices in one
                 # step (no staging copy through device 0)
                 take = lambda a, ids: jax.device_put(a[ids], agents_sharding)  # noqa: E731
+                take_block = lambda a, ids: jax.device_put(  # noqa: E731
+                    a[ids], block_sharding)
                 round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
                                                            norm, mesh)
                 diag_round_fn_host = (
                     make_sharded_round_fn_host(cfg, model, norm, mesh)
                     if cfg.diagnostics else round_fn_host)
+                if chain_n > 1:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                        make_sharded_chained_round_fn_host)
+                    host_chained_fn = make_sharded_chained_round_fn_host(
+                        plain_cfg, model, norm, mesh)
             else:
                 print(f"[mesh] no device count <= {cfg.mesh or 'all'} "
                       f"divides agents_per_round="
@@ -174,36 +208,55 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             round_fn_host = make_round_fn_host(plain_cfg, model, norm)
             diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
                                   if cfg.diagnostics else round_fn_host)
+            if chain_n > 1 and jax.process_count() == 1:
+                from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                    make_chained_round_fn_host)
+                host_chained_fn = make_chained_round_fn_host(plain_cfg,
+                                                             model, norm)
 
-        def gather_round(rnd):
+        def sample_ids(rnd):
             # per-round generator so --resume continues the same sampling
             # sequence the uninterrupted run would have used
             rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
-            ids = rng.choice(cfg.num_agents, cfg.agents_per_round,
-                             replace=False)
-            return (ids, take(fed.train.images, ids),
-                    take(fed.train.labels, ids),
-                    take(fed.train.sizes, ids))
+            return rng.choice(cfg.num_agents, cfg.agents_per_round,
+                              replace=False)
+
+        def gather_unit(unit):
+            """One dispatch unit's payload: a single round's [m, ...] stacks
+            or a chained block's [chain, m, ...] stacks (one placement)."""
+            ids = np.stack([sample_ids(r) for r in unit])
+            if len(unit) == 1:
+                return (ids[0], take(fed.train.images, ids[0]),
+                        take(fed.train.labels, ids[0]),
+                        take(fed.train.sizes, ids[0]))
+            return (ids, take_block(fed.train.images, ids),
+                    take_block(fed.train.labels, ids),
+                    take_block(fed.train.sizes, ids))
 
         # host gather + H2D transfer overlap the running round program
-        # (data/prefetch.py); created lazily at the first round so a resumed
-        # run prefetches from its restored start round
+        # (data/prefetch.py); created lazily at the first dispatch so a
+        # resumed run prefetches from its restored start round
         if cfg.host_prefetch > 0:
             print(f"[prefetch] host->device pipeline, depth "
                   f"{cfg.host_prefetch}")
 
-        def host_sampler(params, key, rnd, want_diag):
+        def get_unit(unit):
             nonlocal prefetcher
             if cfg.host_prefetch > 0:
                 if prefetcher is None:
+                    # sched_units is THE loop's schedule (assigned before the
+                    # loop starts; the first get_unit call is its first
+                    # entry), so production order provably matches
+                    # consumption order
                     from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
                         RoundPrefetcher)
-                    prefetcher = RoundPrefetcher(
-                        gather_round, range(rnd, cfg.rounds + 1),
-                        depth=cfg.host_prefetch)
-                ids, imgs, lbls, szs = prefetcher.get(rnd)
-            else:
-                ids, imgs, lbls, szs = gather_round(rnd)
+                    prefetcher = RoundPrefetcher(gather_unit, sched_units,
+                                                 depth=cfg.host_prefetch)
+                return prefetcher.get(unit)
+            return gather_unit(unit)
+
+        def host_sampler(params, key, rnd, want_diag):
+            ids, imgs, lbls, szs = get_unit((rnd,))
             fn = diag_round_fn_host if want_diag else round_fn_host
             new_params, info = fn(params, key, imgs, lbls, szs)
             info["sampled"] = ids
@@ -219,8 +272,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
                 make_chained_round_fn)
             chained_fn = make_chained_round_fn(plain_cfg, model, norm, *arrays)
-    if chained_fn is not None:
-        print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan)")
+    if chained_fn is not None or host_chained_fn is not None:
+        print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan"
+              + (", host-sampled blocks)" if host_chained_fn is not None
+                 else ")"))
 
     if jax.process_count() > 1 and n_mesh <= 1:
         # no global-mesh SPMD path was taken: every process would run the
@@ -243,6 +298,8 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             diag_round_fn_host = guard_round_fn(diag_round_fn_host)
         if chained_fn is not None:
             chained_fn = guard_round_fn(chained_fn)
+        if host_chained_fn is not None:
+            host_chained_fn = guard_round_fn(host_chained_fn)
 
     if cfg.use_pallas:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
@@ -307,28 +364,34 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     t_steady_end = None
     rounds_at_steady_end = 0
     rnd = start_round
+    # ONE source of truth for chaining decisions: the loop consumes the
+    # same schedule the host-mode prefetcher produces against, so the two
+    # cannot desynchronize (code review r3)
+    units = dispatch_schedule(
+        start_round, cfg.rounds, cfg.snap, chain_n, cfg.diagnostics,
+        chained_fn is not None or host_chained_fn is not None)
+    sched_units = units   # consumed by get_unit's lazy prefetcher creation
     # any exception must still tear down the prefetch worker —
     # it pins device arrays and would leak per failed run
     try:
-        while rnd < cfg.rounds:
-            # rounds until the next eval boundary (or the end of the run)
-            to_eval = min(cfg.snap - rnd % cfg.snap, cfg.rounds - rnd)
-            # a diagnostic snap round must run unchained (it needs prev_params
-            # and the diag-compiled variant), so it is excluded from the budget
-            # — but only when the block actually ends on a snap round (the run
-            # may end mid-interval)
-            diag_at_boundary = cfg.diagnostics and (rnd + to_eval) % cfg.snap == 0
-            budget = to_eval - (1 if diag_at_boundary else 0)
-            if chained_fn is not None and budget >= chain_n:
-                # fixed block length => one compilation serves every block
-                ids = jnp.arange(rnd + 1, rnd + chain_n + 1)
-                params, stacked = chained_fn(params, base_key, ids)
-                rnd += chain_n
-                rounds_done += chain_n
+        for unit in units:
+            if len(unit) > 1:
+                # chained block: fixed length => one compilation per shape
+                ids = jnp.arange(unit[0], unit[-1] + 1)
+                if chained_fn is not None:
+                    params, stacked = chained_fn(params, base_key, ids)
+                else:
+                    # host-sampled block: the prefetcher hands over the
+                    # whole [chain, m, ...] shard-stack payload at once
+                    _, imgs, lbls, szs = get_unit(unit)
+                    params, stacked = host_chained_fn(params, base_key, ids,
+                                                      imgs, lbls, szs)
+                rnd = unit[-1]
+                rounds_done += len(unit)
                 info = {"train_loss": stacked["train_loss"][-1]}
                 want_diag, prev_params = False, None
             else:
-                rnd += 1
+                rnd = unit[0]
                 key = jax.random.fold_in(base_key, rnd)
                 snap_round = rnd % cfg.snap == 0
                 want_diag = cfg.diagnostics and snap_round

@@ -153,6 +153,18 @@ def main():
                                      robustLR_threshold=4, **fm)),
     ]
     if not args.quick:
+        # copyright watermark trojan end-to-end (ref utils.py:232-242 cv2
+        # path; VERDICT r2 missing #3): the real reference PNG is stamped
+        # when RLR_ASSET_DIR (or data_dir's parent) holds watermark.png —
+        # run with RLR_ASSET_DIR=/root/reference for pixel-parity assets
+        configs += [
+            ("fmnist-attack-copyright",
+             Config(num_corrupt=1, poison_frac=0.5,
+                    pattern_type="copyright", **fm)),
+            ("fmnist-attack-copyright-rlr",
+             Config(num_corrupt=1, poison_frac=0.5,
+                    pattern_type="copyright", robustLR_threshold=4, **fm)),
+        ]
         # reference src/runner.sh:23-28 cifar10 DBA (40 agents, 4 corrupt,
         # thr=8) — scaled rounds; ResNet-9 is the BASELINE.json configs[3]
         # arch, the faithful CNN_CIFAR is cfg.arch='cnn'
@@ -202,8 +214,10 @@ def main():
             # client_lr=0.02 is a documented calibration: the reference's
             # default 0.1 oscillation-collapses the synthetic proxy at 1%
             # participation (real Fed-EMNIST tolerates it, per the paper).
+            # chain=5 (r3): host-sampled chained blocks — 5 rounds of 33
+            # prefetched shard stacks (~165 MB/unit) per XLA dispatch
             ff = dict(data="fedemnist", num_agents=3383, agent_frac=0.01,
-                      local_ep=10, bs=64, rounds=500, snap=25,
+                      local_ep=10, bs=64, rounds=500, snap=25, chain=5,
                       client_lr=0.02, seed=0,
                       synth_hardness=args.hardness_fedemnist,
                       tensorboard=False, data_dir=args.full_data_dir)

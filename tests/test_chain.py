@@ -79,6 +79,128 @@ def test_sharded_chained_matches_sharded_per_round():
     assert stacked["train_loss"].shape == (n,)
 
 
+def test_host_chained_matches_per_round_host():
+    """Host-sampled chained blocks (fl/rounds.make_chained_round_fn_host)
+    must match per-round host dispatch on the same shard payloads + keys."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn_host, make_round_fn_host)
+
+    cfg, model, params, norm, arrays = _setup()
+    images, labels, sizes = map(np.asarray, arrays)
+    m = cfg.agents_per_round
+    base_key = jax.random.PRNGKey(11)
+    n = 3
+    rng = np.random.default_rng(0)
+    ids = np.stack([rng.choice(cfg.num_agents, m, replace=False)
+                    for _ in range(n)])                 # [n, m]
+
+    round_fn = make_round_fn_host(cfg, model, norm)
+    p_seq = params
+    losses = []
+    for i, r in enumerate(range(1, n + 1)):
+        p_seq, info = round_fn(p_seq, jax.random.fold_in(base_key, r),
+                               jnp.asarray(images[ids[i]]),
+                               jnp.asarray(labels[ids[i]]),
+                               jnp.asarray(sizes[ids[i]]))
+        losses.append(float(info["train_loss"]))
+
+    chained = make_chained_round_fn_host(cfg, model, norm)
+    p_chain, stacked = chained(params, base_key, jnp.arange(1, n + 1),
+                               jnp.asarray(images[ids]),
+                               jnp.asarray(labels[ids]),
+                               jnp.asarray(sizes[ids]))
+
+    _assert_trees_close(p_seq, p_chain, atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(stacked["train_loss"]),
+                               np.array(losses), rtol=1e-5)
+
+
+def test_sharded_host_chained_matches_per_round():
+    """Sharded host-chained blocks: [chain, m, ...] stacks sharded on the m
+    axis (P(None, agents)), scan slices a round per step, collectives inside
+    the scan (parallel/rounds.make_sharded_chained_round_fn_host)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+        AGENTS_AXIS)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+        make_sharded_chained_round_fn_host, make_sharded_round_fn_host)
+
+    cfg, model, params, norm, arrays = _setup(num_agents=8)
+    images, labels, sizes = map(np.asarray, arrays)
+    mesh = make_mesh(4)
+    m = cfg.agents_per_round
+    agents_sh = NamedSharding(mesh, P(AGENTS_AXIS))
+    block_sh = NamedSharding(mesh, P(None, AGENTS_AXIS))
+    base_key = jax.random.PRNGKey(13)
+    n = 2
+    rng = np.random.default_rng(1)
+    ids = np.stack([rng.choice(cfg.num_agents, m, replace=False)
+                    for _ in range(n)])
+
+    round_fn = make_sharded_round_fn_host(cfg, model, norm, mesh)
+    p_seq = params
+    for i, r in enumerate(range(1, n + 1)):
+        p_seq, _ = round_fn(p_seq, jax.random.fold_in(base_key, r),
+                            jax.device_put(images[ids[i]], agents_sh),
+                            jax.device_put(labels[ids[i]], agents_sh),
+                            jax.device_put(sizes[ids[i]], agents_sh))
+
+    chained = make_sharded_chained_round_fn_host(cfg, model, norm, mesh)
+    p_chain, stacked = chained(params, base_key, jnp.arange(1, n + 1),
+                               jax.device_put(images[ids], block_sh),
+                               jax.device_put(labels[ids], block_sh),
+                               jax.device_put(sizes[ids], block_sh))
+
+    _assert_trees_close(p_seq, p_chain, atol=1e-5, rtol=1e-5)
+    assert stacked["train_loss"].shape == (n,)
+
+
+def test_dispatch_schedule_covers_rounds_in_order():
+    """The precomputed prefetch schedule must make exactly the driver loop's
+    decisions: all rounds once, in order; chained blocks never cross an eval
+    boundary; a diagnostics run keeps its snap rounds unchained."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        dispatch_schedule)
+
+    for start, total, snap, chain_n, diag in [
+            (0, 20, 5, 3, False), (0, 20, 5, 3, True), (7, 23, 5, 4, False),
+            (3, 7, 5, 3, True), (0, 10, 10, 10, False), (0, 9, 4, 2, True)]:
+        units = dispatch_schedule(start, total, snap, chain_n, diag, True)
+        flat = [r for u in units for r in u]
+        assert flat == list(range(start + 1, total + 1)), (start, total)
+        for u in units:
+            assert len(u) in (1, chain_n)
+            if len(u) > 1:
+                # no eval boundary strictly inside the block
+                assert all(r % snap != 0 for r in u[:-1])
+                # diagnostics snap rounds stay unchained
+                if diag:
+                    assert u[-1] % snap != 0
+        # unchained mode degenerates to singletons
+        assert all(len(u) == 1 for u in dispatch_schedule(
+            start, total, snap, chain_n, diag, False))
+
+
+def test_run_host_chain_matches_unchained(tmp_path):
+    """Driver-level: host-sampled mode with --chain must produce the same
+    curve as unchained host-sampled mode (same sampling sequence, same keys),
+    through the unit-based prefetcher."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import run
+
+    base = Config(data="synthetic", num_agents=4, bs=16, local_ep=1,
+                  synth_train_size=128, synth_val_size=32, rounds=4, snap=2,
+                  seed=9, log_dir=str(tmp_path), tensorboard=False,
+                  host_sampled="on")
+    s1 = run(base)
+    s2 = run(base.replace(chain=2))
+    np.testing.assert_allclose(s1["val_acc"], s2["val_acc"], rtol=1e-5)
+    np.testing.assert_allclose(s1["val_loss"], s2["val_loss"], rtol=1e-4)
+    # and the no-prefetch path takes the same schedule
+    s3 = run(base.replace(chain=2, host_prefetch=0))
+    np.testing.assert_allclose(s1["val_loss"], s3["val_loss"], rtol=1e-4)
+
+
 def test_run_with_chain_matches_unchained(tmp_path):
     from defending_against_backdoors_with_robust_learning_rate_tpu.train import run
 

@@ -70,3 +70,58 @@ def test_latest_round_ignores_orbax_tmp_dirs(tmp_path):
     (d / "round_000005").mkdir(parents=True)
     (d / "round_000007.orbax-checkpoint-tmp-12345").mkdir()
     assert ckpt.latest_round(str(d)) == 5
+
+
+def _resume_cfg(tmp_path, tag, **kw):
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+        Config)
+
+    return Config(data="synthetic", num_agents=4, bs=16, local_ep=1,
+                  synth_train_size=128, synth_val_size=32, seed=21,
+                  snap=5, chain=3, tensorboard=False,
+                  log_dir=str(tmp_path / f"logs_{tag}"),
+                  checkpoint_dir=str(tmp_path / f"ck_{tag}"), **kw)
+
+
+def _restored_params(cfg):
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    like = init_params(model, cfg.image_shape, jax.random.PRNGKey(cfg.seed))
+    rnd, params, *_ = ckpt.restore(cfg.checkpoint_dir, like)
+    return rnd, params
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.parametrize("host_sampled", ["auto", "on"])
+def test_resume_mid_chain_continues_exact_sequence(tmp_path, host_sampled):
+    """--resume restoring at a round where rnd % chain != 0 (round 5 with
+    chain=3) must continue the exact sampling/key sequence through the next
+    partial block: the budget logic re-enters a chained block (6-8), then
+    singles (9, 10). Checked by bitwise-comparing the round-10 checkpoint of
+    a resumed run against an uninterrupted one, for both the device-resident
+    and host-sampled (unit-prefetched) paths."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        run)
+
+    cfg_a = _resume_cfg(tmp_path, f"a_{host_sampled}", rounds=10,
+                        host_sampled=host_sampled)
+    run(cfg_a)
+    rnd_a, p_a = _restored_params(cfg_a)
+    assert rnd_a == 10
+
+    cfg_b = _resume_cfg(tmp_path, f"b_{host_sampled}", rounds=5,
+                        host_sampled=host_sampled)
+    run(cfg_b)
+    rnd_mid, _ = _restored_params(cfg_b)
+    assert rnd_mid == 5 and rnd_mid % cfg_b.chain != 0
+    run(cfg_b.replace(rounds=10, resume=True))
+    rnd_b, p_b = _restored_params(cfg_b)
+    assert rnd_b == 10
+
+    for a, b in zip(jax.tree_util.tree_leaves(p_a),
+                    jax.tree_util.tree_leaves(p_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
