@@ -17,21 +17,30 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def timed(fn, *args, reps=5, warmup=1):
+REPS = 5
+
+
+def timed(fn, *args, warmup=1):
     import jax
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))
     t0 = time.perf_counter()
-    for _ in range(reps):
+    for _ in range(REPS):
         out = fn(*args)
     jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+    return (time.perf_counter() - t0) / REPS
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--platform", default="")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + 1 rep: validates the script runs "
+                         "end-to-end (timings meaningless)")
     args = ap.parse_args()
+    if args.smoke:
+        global REPS
+        REPS = 1
 
     import jax
     if args.platform:
@@ -63,6 +72,11 @@ def main():
                  num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
                  synth_train_size=(6000 if on_cpu else 60000),
                  synth_val_size=(1000 if on_cpu else 10000), seed=0)
+    if args.smoke:
+        # force the synthetic fallback: the on-disk fmnist files have the
+        # full 60k geometry regardless of synth_* settings
+        cfg = cfg.replace(bs=32, synth_train_size=640, synth_val_size=128,
+                          data_dir="/nonexistent_use_synthetic")
     if on_cpu:
         print("[profile] CPU backend: reduced shapes (6k train) — timings "
               "are not comparable to TPU rows", flush=True)
@@ -132,16 +146,85 @@ def main():
                              rngs={"dropout": jax.random.PRNGKey(0)})
         return masked_ce(logits, y, w)
 
+    def loss_fn_nodrop(p):
+        logits = model.apply({"params": p}, norm(x), train=False)
+        return masked_ce(logits, y, w)
+
     fwd = jax.jit(loss_fn)
     fwdbwd = jax.jit(jax.value_and_grad(loss_fn))
+    fwdbwd_nd = jax.jit(jax.value_and_grad(loss_fn_nodrop))
     t_fwd = timed(fwd, params)
     t_fb = timed(fwdbwd, params)
+    t_fb_nd = timed(fwdbwd_nd, params)
     n_steps = cfg.local_ep * (imgs.shape[1] // cfg.bs)
     print(f"one eff-batch[{m*cfg.bs}] fwd:     {t_fwd*1e3:8.1f} ms",
           flush=True)
     print(f"one eff-batch[{m*cfg.bs}] fwd+bwd: {t_fb*1e3:8.1f} ms "
           f"(x {n_steps} steps/round = {t_fb*n_steps*1e3:.0f} ms)",
           flush=True)
+    print(f"  ... without dropout:  {t_fb_nd*1e3:8.1f} ms "
+          f"(dropout RNG+mask cost {100*(t_fb-t_fb_nd)/max(t_fb,1e-12):.0f}% "
+          f"of step)", flush=True)
+
+    # 6. per-epoch shuffle cost (fl/client.py: uniform + argsort per agent
+    #    per epoch) — VERDICT r2 candidate sink
+    n_total = imgs.shape[1]
+
+    @jax.jit
+    def shuffles(key):
+        ks = jax.random.split(key, m * cfg.local_ep)
+        return jax.vmap(
+            lambda k: jnp.argsort(jax.random.uniform(k, (n_total,))))(ks)
+
+    t_shuf = timed(shuffles, key)
+    print(f"shuffles ({m}x{cfg.local_ep} argsort[{n_total}]): "
+          f"{t_shuf*1e3:8.1f} ms/round", flush=True)
+
+    # 7. per-step batch gather (dynamic_slice of perm + row gather from the
+    #    agent's padded shard)
+    perm_all = shuffles(key)[:m]
+
+    @jax.jit
+    def gathers(perm_all):
+        idx = jax.lax.dynamic_slice_in_dim(perm_all, 0, cfg.bs, axis=1)
+        return jax.vmap(lambda im, ix: jnp.take(im, ix, axis=0))(
+            imgs[:m], idx)
+
+    t_gather = timed(gathers, perm_all)
+    print(f"batch gather [{m}x{cfg.bs}]:  {t_gather*1e3:8.1f} ms "
+          f"(x {n_steps} steps/round = {t_gather*n_steps*1e3:.0f} ms)",
+          flush=True)
+
+    # --- top-sinks summary: the round decomposed into measured components
+    accounted = (t_fb + t_gather) * n_steps + t_shuf
+    print("\n[summary] round anatomy (steady-state):", flush=True)
+    rows = [
+        ("fwd+bwd compute", t_fb * n_steps),
+        ("batch gathers", t_gather * n_steps),
+        ("epoch shuffles", t_shuf),
+        ("server step", t_server),
+        ("residual (scan/loop overhead, optimizer, clip)",
+         max(t_round - accounted - t_server, 0.0)),
+    ]
+    for name, t in sorted(rows, key=lambda r: -r[1]):
+        print(f"  {name:<46s} {t*1e3:8.1f} ms  "
+              f"({100*t/t_round:5.1f}% of round)", flush=True)
+
+    # --- FLOPs / MFU from XLA's cost analysis (same math as bench.py)
+    try:
+        from bench import peak_tflops, train_step_flops
+        step_flops = train_step_flops(model, params, norm, cfg,
+                                      fed.train.images.shape[2:])
+        flops_round = cfg.agents_per_round * cfg.local_ep * \
+            (imgs.shape[1] // cfg.bs) * step_flops
+        peak = peak_tflops(jax.devices()[0].device_kind)
+        tfs = flops_round / t_round / 1e12
+        print(f"\n[mfu] {flops_round/1e12:.2f} TFLOP/round -> "
+              f"{tfs:.1f} TFLOP/s"
+              + (f" = {100*tfs/peak:.1f}% MFU of {peak:.0f} TFLOP/s bf16 "
+                 f"peak" if peak else ""), flush=True)
+    except Exception as e:
+        print(f"[mfu] cost analysis unavailable: {e}", flush=True)
 
 
 if __name__ == "__main__":
