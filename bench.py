@@ -135,6 +135,9 @@ def main():
                     help="timed steady-state blocks")
     ap.add_argument("--dtype", default="",
                     help="override compute dtype (e.g. bfloat16)")
+    ap.add_argument("--rng_impl", choices=("auto", "threefry", "rbg"),
+                    default="auto",
+                    help="PRNG bit generator (auto = hardware rbg on TPU)")
     ap.add_argument("--use_pallas", action="store_true",
                     help="fused Pallas RLR+FedAvg server step")
     ap.add_argument("--probe_timeout", type=float, default=90.0)
@@ -170,6 +173,12 @@ def main():
     import jax.numpy as jnp
 
     from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        apply_rng_impl)
+
+    rng_impl = apply_rng_impl(args.rng_impl)
+    log(f"[bench] prng impl: {rng_impl}")
+
     from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
         get_federated_data)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
@@ -263,6 +272,7 @@ def main():
            "vs_baseline": round(vs_baseline, 2),
            "compile_s": round(compile_s, 1),
            "chain": chain,
+           "rng_impl": rng_impl,
            "device": str(device)}
     if flops_round is not None:
         out["tflop_per_round"] = round(flops_round / 1e12, 4)

@@ -61,6 +61,13 @@ class Config:
     process_id: int = -1            # this process's id; -1 = auto
     arch: str = "auto"              # auto | cnn | resnet9
     dtype: str = "f32"              # f32 | bf16 (compute dtype on the MXU)
+    rng_impl: str = "auto"          # auto: hardware RNG (rbg) on TPU,
+                                    # threefry elsewhere; threefry | rbg
+                                    # force. Measured +13% round throughput
+                                    # on v5e (threefry dropout-mask bits
+                                    # are 15% of the round). A checkpoint
+                                    # must resume under the impl that
+                                    # wrote it (key data shapes differ).
     mesh: int = 1                   # devices on the `agents` mesh axis; 0 = all
     chain: int = 1                  # rounds fused per dispatch via lax.scan
                                     # (capped at `snap`; >1 kills per-round
@@ -195,6 +202,12 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--arch", type=str, default=d.arch,
                    help="auto|cnn|resnet9 (BASELINE.json configs[3-4])")
     p.add_argument("--dtype", type=str, default=d.dtype, help="f32|bf16")
+    p.add_argument("--rng_impl", choices=("auto", "threefry", "rbg"),
+                   default=d.rng_impl,
+                   help="PRNG bit generator: auto = hardware RNG (rbg) on "
+                        "TPU (+13%% measured round throughput), threefry "
+                        "elsewhere; checkpoints must resume under the impl "
+                        "that wrote them")
     p.add_argument("--coordinator", type=str, default=d.coordinator,
                    help="multi-host: host:port of process 0 "
                         "(jax.distributed rendezvous)")

@@ -23,6 +23,8 @@ agents on one chip and `shard_map`ped over the `agents` mesh axis at scale.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -39,8 +41,23 @@ def make_local_train(model, cfg, normalize):
 
     images: [n_total, H, W, C] raw pixels, n_total a multiple of cfg.bs;
     labels: [n_total] int32; size: scalar int32 true shard size; key: PRNGKey.
+
+    RLR_ABLATE (measurement-only, comma-separated): in-program ablations for
+    the round-anatomy ladder (scripts/profile_round.py --ablate) — the ~13 ms
+    per-dispatch floor through the TPU tunnel makes standalone micro-probes
+    meaningless, so sinks are isolated by differencing FULL-round timings:
+      noshuffle  — identity permutation (skips per-epoch uniform+argsort)
+      nodropout  — deterministic forward (skips dropout RNG + masks)
+      nogather   — ordered contiguous batches (skips the per-step row gather)
+    Every ablation CHANGES TRAINING SEMANTICS; never set outside profiling.
     """
     bs = cfg.bs
+    ablate = set(filter(None, os.environ.get("RLR_ABLATE", "").split(",")))
+    if ablate:
+        # loud on purpose: a leftover env var silently corrupts training
+        print(f"[ABLATE] local training is running with {sorted(ablate)} "
+              f"REMOVED — measurement mode, results are not real training",
+              flush=True)
 
     def local_train(params0, images, labels, size, key):
         n_total = images.shape[0]
@@ -54,21 +71,35 @@ def make_local_train(model, cfg, normalize):
         def epoch_body(carry, ep_key):
             params, mom = carry
             shuffle_key, drop_key = jax.random.split(ep_key)
-            r = jax.random.uniform(shuffle_key, (n_total,))
-            r = jnp.where(jnp.arange(n_total) < size, r, 2.0)
-            perm = jnp.argsort(r)          # real samples first, shuffled
+            if "noshuffle" in ablate:
+                perm = jnp.arange(n_total)  # real samples already in front
+            else:
+                r = jax.random.uniform(shuffle_key, (n_total,))
+                r = jnp.where(jnp.arange(n_total) < size, r, 2.0)
+                perm = jnp.argsort(r)      # real samples first, shuffled
 
             def batch_body(carry, b):
                 params, mom = carry
                 idx = jax.lax.dynamic_slice(perm, (b * bs,), (bs,))
-                x = jnp.take(images, idx, axis=0)
+                if "nogather" in ablate:
+                    # remove only the IMAGE row gather; labels still gather
+                    # through perm so the shuffle stays live — otherwise XLA
+                    # DCEs uniform+argsort along with the gather and the
+                    # delta misattributes the shuffle's cost (code review r3)
+                    x = jax.lax.dynamic_slice_in_dim(images, b * bs, bs, 0)
+                else:
+                    x = jnp.take(images, idx, axis=0)
                 y = jnp.take(labels, idx, axis=0)
                 w = (b * bs + jnp.arange(bs)) < size
 
                 def loss_fn(p):
-                    logits = model.apply(
-                        {"params": p}, normalize(x), train=True,
-                        rngs={"dropout": jax.random.fold_in(drop_key, b)})
+                    if "nodropout" in ablate:
+                        logits = model.apply({"params": p}, normalize(x),
+                                             train=False)
+                    else:
+                        logits = model.apply(
+                            {"params": p}, normalize(x), train=True,
+                            rngs={"dropout": jax.random.fold_in(drop_key, b)})
                     return masked_ce(logits, y, w)
 
                 loss, grads = jax.value_and_grad(loss_fn)(params)

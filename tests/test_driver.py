@@ -177,3 +177,15 @@ def test_driver_reports_steady_throughput():
     # wall-clock figure exists alongside and includes compile, so the
     # steady figure can only be >= it on these tiny runs
     assert summary["steady_rounds_per_sec"] >= summary["rounds_per_sec"]
+
+
+def test_driver_rng_impl_rbg():
+    """--rng_impl=rbg (the TPU hardware-RNG lever; forced here on CPU via
+    XLA's RngBitGenerator) trains end-to-end; the impl is restored to the
+    default afterwards so the rest of the suite keeps threefry streams."""
+    try:
+        summary = _run(BASE.replace(rng_impl="rbg", num_corrupt=1,
+                                    poison_frac=1.0, robustLR_threshold=3))
+        assert summary["round"] == 4 and np.isfinite(summary["val_acc"])
+    finally:
+        jax.config.update("jax_default_prng_impl", "threefry2x32")
