@@ -28,6 +28,13 @@ axis).
 
 CPU/tests run the same kernel with interpret=True; `use_pallas=False`
 (default) keeps the pure-jnp path (ops/aggregate.py).
+
+Measured on TPU (BENCH_NOTES.md r2+r3): a NULL at every probed shape —
+m=10 x 1.2M params (+0.4%) and m=40 x 6.5M ResNet-9 (0.253 r/s both
+paths) — because any round with real local training dwarfs the server
+step. The kernel stays as the documented opt-in and as the per-device
+building block (`partial_vote_avg_flat`) of the sharded fused step, where
+the one-pass property composes with psums over the `agents` mesh.
 """
 
 from __future__ import annotations
