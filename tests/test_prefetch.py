@@ -1,0 +1,122 @@
+"""RoundPrefetcher unit tests: ordering, error propagation, stall
+heartbeat, dead-worker detection, close() teardown (data/prefetch.py)."""
+
+import threading
+import time
+
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+    RoundPrefetcher)
+
+
+def test_in_order_delivery_and_close():
+    got = []
+    pf = RoundPrefetcher(lambda r: r * 10, range(1, 6), depth=2)
+    for r in range(1, 6):
+        got.append(pf.get(r))
+    pf.close()
+    assert got == [10, 20, 30, 40, 50]
+
+
+def test_unit_tuple_keys():
+    """Dispatch-unit keys (tuples of round ids, the host-chain schedule)
+    work as round ids: equality-checked against production order."""
+    units = [(1, 2, 3), (4,), (5, 6, 7)]
+    pf = RoundPrefetcher(lambda u: sum(u), units, depth=1)
+    try:
+        assert pf.get((1, 2, 3)) == 6
+        assert pf.get((4,)) == 4
+        assert pf.get((5, 6, 7)) == 18
+    finally:
+        pf.close()
+
+
+def test_order_violation_raises():
+    pf = RoundPrefetcher(lambda r: r, range(1, 4), depth=1)
+    try:
+        with pytest.raises(RuntimeError, match="order violation"):
+            pf.get(2)   # producer made round 1
+    finally:
+        pf.close()
+
+
+def test_producer_exception_surfaces():
+    def boom(r):
+        if r == 2:
+            raise ValueError("synthetic gather failure")
+        return r
+
+    pf = RoundPrefetcher(boom, range(1, 4), depth=1)
+    try:
+        assert pf.get(1) == 1
+        with pytest.raises(RuntimeError, match="worker failed"):
+            pf.get(2)
+    finally:
+        pf.close()
+
+
+def test_exhaustion_raises():
+    pf = RoundPrefetcher(lambda r: r, range(1, 3), depth=1)
+    try:
+        pf.get(1), pf.get(2)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            pf.get(3)
+    finally:
+        pf.close()
+
+
+def test_stall_heartbeat_is_logged(capsys, monkeypatch):
+    """A wedged produce() must not hang get() silently: the periodic
+    timeout logs an attributable heartbeat (ADVICE r2), and delivery still
+    succeeds once the worker unwedges."""
+    monkeypatch.setattr(RoundPrefetcher, "STALL_WARN_SEC", 0.1)
+    release = threading.Event()
+
+    def slow(r):
+        release.wait(5.0)
+        return r
+
+    pf = RoundPrefetcher(slow, range(1, 2), depth=1)
+    try:
+        t = threading.Timer(0.35, release.set)
+        t.start()
+        assert pf.get(1) == 1
+        t.cancel()
+        out = capsys.readouterr().out
+        assert "stalled waiting for round 1" in out
+        assert "worker alive" in out
+    finally:
+        release.set()
+        pf.close()
+
+
+def test_dead_worker_without_sentinel_raises(monkeypatch):
+    """If the worker thread dies so hard the sentinel never lands (here:
+    simulated by draining the queue after a kill), get() reports it
+    instead of blocking forever."""
+    monkeypatch.setattr(RoundPrefetcher, "STALL_WARN_SEC", 0.05)
+    # empty round range: the worker exits immediately after its sentinel;
+    # draining that sentinel forges the pathological dead-no-sentinel state
+    pf = RoundPrefetcher(lambda r: r, range(0), depth=2)
+    pf._thread.join(timeout=5.0)
+    assert not pf._thread.is_alive()
+    while not pf._q.empty():
+        pf._q.get_nowait()
+    with pytest.raises(RuntimeError, match="died without sentinel"):
+        pf.get(1)
+    pf.close()
+
+
+def test_close_interrupts_blocked_worker():
+    """close() returns promptly even when the worker is blocked mid-put
+    on a full queue (nothing consumes)."""
+    pf = RoundPrefetcher(lambda r: bytes(1024), range(1, 100), depth=1)
+    time.sleep(0.2)        # let the queue fill and the worker block
+    t0 = time.monotonic()
+    pf.close()
+    # the drain must interrupt the worker's 0.5s put-timeout loop almost
+    # immediately; anywhere near close()'s 10s give-up deadline means the
+    # interrupt path regressed (bound deliberately far below 10s)
+    assert time.monotonic() - t0 < 3.0
+    assert not pf._thread.is_alive()
