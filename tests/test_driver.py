@@ -189,3 +189,15 @@ def test_driver_rng_impl_rbg():
         assert summary["round"] == 4 and np.isfinite(summary["val_acc"])
     finally:
         jax.config.update("jax_default_prng_impl", "threefry2x32")
+
+
+def test_driver_host_chain_with_diagnostics(monkeypatch):
+    """diagnostics + host-sampled + --chain: the dispatch schedule must keep
+    every snap round unchained (it needs prev_params + the diag-compiled
+    variant) while chaining the off-snap budget, all through the unit
+    prefetcher. Covers the three-way interaction end-to-end."""
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    cfg = BASE.replace(rounds=6, snap=2, chain=2, diagnostics=True,
+                       num_corrupt=1, poison_frac=1.0, robustLR_threshold=3)
+    summary = _run(cfg)
+    assert summary["round"] == 6 and np.isfinite(summary["val_acc"])
