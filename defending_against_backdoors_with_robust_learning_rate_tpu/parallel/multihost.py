@@ -112,6 +112,19 @@ def take_agents_sharded(mesh: Mesh, base: np.ndarray, ids: np.ndarray):
         shape, sharding, lambda idx: base[ids[idx[0]]])
 
 
+def take_agents_sharded_block(mesh: Mesh, base: np.ndarray,
+                              ids_blk: np.ndarray):
+    """`base[ids_blk]` for a [chain, m] id block as a global
+    [chain, m, ...] jax.Array sharded on the m axis (P(None, agents)) —
+    the chained-host payload (fl/rounds.make_chained_host). Same
+    no-full-stack property as `take_agents_sharded`: each process
+    fancy-index-copies only its addressable [chain, m/P, ...] block."""
+    sharding = NamedSharding(mesh, P(None, AGENTS_AXIS))
+    shape = ids_blk.shape + base.shape[1:]
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: base[ids_blk[idx[0], idx[1]]])
+
+
 def put_replicated(mesh: Mesh, x):
     """Promote (a pytree of) process-local arrays, identical on every host
     (seeded data / init), to fully-replicated global jax.Arrays."""

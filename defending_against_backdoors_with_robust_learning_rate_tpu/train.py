@@ -182,10 +182,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                   f"({cfg.agents_per_round // n_mesh} agents/device), "
                   f"host-sampled shards, {jax.process_count()} processes")
             take = lambda a, ids: multihost.take_agents_sharded(mesh, a, ids)  # noqa: E731
+            take_block = lambda a, ids: multihost.take_agents_sharded_block(  # noqa: E731
+                mesh, a, ids)
             params = multihost.put_replicated(mesh, params)
-            if cfg.chain > 1:
-                print("[chain] multi-process host-sampled gathers are "
-                      "per-round (take_agents_sharded); --chain ignored")
             round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
                                                        norm, mesh)
             diag_round_fn_host = (
@@ -218,11 +217,6 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 diag_round_fn_host = (
                     make_sharded_round_fn_host(cfg, model, norm, mesh)
                     if cfg.diagnostics else round_fn_host)
-                if chain_n > 1:
-                    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-                        make_sharded_chained_round_fn_host)
-                    host_chained_fn = make_sharded_chained_round_fn_host(
-                        plain_cfg, model, norm, mesh)
             else:
                 print(f"[mesh] no device count <= {cfg.mesh or 'all'} "
                       f"divides agents_per_round="
@@ -231,7 +225,17 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             round_fn_host = make_round_fn_host(plain_cfg, model, norm)
             diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
                                   if cfg.diagnostics else round_fn_host)
-            if chain_n > 1 and jax.process_count() == 1:
+        # one site builds the chained-host variant for whichever round fn
+        # was picked above (sharded single- or multi-process mesh, or
+        # single-device); a multi-process job WITHOUT the global mesh gets
+        # no chaining (it is the redundant-work warning case below)
+        if chain_n > 1:
+            if n_mesh > 1:
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                    make_sharded_chained_round_fn_host)
+                host_chained_fn = make_sharded_chained_round_fn_host(
+                    plain_cfg, model, norm, mesh)
+            elif jax.process_count() == 1:
                 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
                     make_chained_round_fn_host)
                 host_chained_fn = make_chained_round_fn_host(plain_cfg,

@@ -191,13 +191,17 @@ def test_driver_rng_impl_rbg():
         jax.config.update("jax_default_prng_impl", "threefry2x32")
 
 
-def test_driver_host_chain_with_diagnostics(monkeypatch):
+def test_driver_host_chain_with_diagnostics(monkeypatch, capsys):
     """diagnostics + host-sampled + --chain: the dispatch schedule must keep
     every snap round unchained (it needs prev_params + the diag-compiled
     variant) while chaining the off-snap budget, all through the unit
-    prefetcher. Covers the three-way interaction end-to-end."""
+    prefetcher. snap=3 with chain=2 so chaining actually engages (snap=2
+    would clamp chain_n to snap-1 = 1 under diagnostics and test nothing —
+    code review r3); the [chain] banner is asserted to keep it that way."""
     monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
-    cfg = BASE.replace(rounds=6, snap=2, chain=2, diagnostics=True,
+    cfg = BASE.replace(rounds=6, snap=3, chain=2, diagnostics=True,
                        num_corrupt=1, poison_frac=1.0, robustLR_threshold=3)
     summary = _run(cfg)
+    out = capsys.readouterr().out
+    assert "[chain] 2 rounds per compiled dispatch" in out, out
     assert summary["round"] == 6 and np.isfinite(summary["val_acc"])

@@ -65,11 +65,12 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.config import Con
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
     NullWriter)
 # host-sampled + global mesh: every process gathers the identical seeded
-# [m, ...] stacks and contributes only its addressable shards
-# (multihost.take_agents_sharded); prefetch pipeline on (default depth)
+# stacks and contributes only its addressable shards; chain=2 makes the
+# dispatch a chained [2, m, ...] block through
+# multihost.take_agents_sharded_block (r3); prefetch pipeline on
 cfg = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
              synth_train_size=256, synth_val_size=64, eval_bs=64,
-             rounds=2, snap=2, seed=5, mesh=0, chain=1,
+             rounds=2, snap=2, seed=5, mesh=0, chain=2,
              num_corrupt=1, poison_frac=1.0, robustLR_threshold=3,
              host_sampled="on", tensorboard=False)
 summary = train.run(cfg, writer=NullWriter())
@@ -115,6 +116,9 @@ def test_two_process_host_sampled_trains():
         assert rc == 0, f"rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
         assert "host-sampled shards, 2 processes" in out, out
         assert "[prefetch] host->device pipeline" in out, out
+        # chained host-sampled blocks over the 2-process global mesh (r3)
+        assert ("[chain] 2 rounds per compiled dispatch (lax.scan, "
+                "host-sampled blocks)") in out, out
         # the redundant-work warning must NOT fire: this IS a distributed job
         assert "training REDUNDANTLY" not in out, out
 
