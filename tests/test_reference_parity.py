@@ -106,8 +106,10 @@ TORCH_ORDER = [("Conv_0", "kernel"), ("Conv_0", "bias"),
                ("Dense_1", "kernel"), ("Dense_1", "bias")]
 
 
-def _to_torch_layout(mod, name, leaf):
-    """One flax leaf -> the equivalent torch tensor layout."""
+def _to_torch_layout(mod, name, leaf, h_feat=H_FEAT, c_feat=C_FEAT):
+    """One flax leaf -> the equivalent torch tensor layout. `h_feat`/`c_feat`
+    are the spatial side / channel count at the flatten (Dense_0's input),
+    so the same conversion serves every conv-stack model."""
     a = np.asarray(leaf)
     if name == "bias":
         return a
@@ -116,8 +118,8 @@ def _to_torch_layout(mod, name, leaf):
         return a.transpose(3, 2, 0, 1)
     if mod == "Dense_0":
         # flatten feeds (h, w, c)-major in flax, (c, h, w)-major in torch
-        a = a.reshape(H_FEAT, H_FEAT, C_FEAT, -1).transpose(2, 0, 1, 3)
-        return a.reshape(H_FEAT * H_FEAT * C_FEAT, -1).T
+        a = a.reshape(h_feat, h_feat, c_feat, -1).transpose(2, 0, 1, 3)
+        return a.reshape(h_feat * h_feat * c_feat, -1).T
     return a.T      # generic dense: flax [in, out] -> torch [out, in]
 
 
@@ -331,6 +333,60 @@ def test_full_round_end_to_end(setup, aggr, use_rlr):
         assert close.mean() > 0.999, (
             f"{(~close).sum()} / {close.size} coords diverged")
         assert np.abs(ours - ref).max() <= 2.0 * slr + 1e-5
+
+
+def test_flax_torch_forward_parity_cifar():
+    """CNN_CIFAR topology pin (src/models.py:33-58): same weights -> same
+    logits through the 3-stage conv/pool stack and the (h,w,c)->(c,h,w)
+    flatten permutation — the second model family's NHWC<->NCHW layout
+    conversion, independent of the MNIST-geometry fixtures above."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.cnn import (
+        CNN_CIFAR)
+
+    Hc, Cc = 2, 256          # spatial side / channels at the flatten
+
+    class _TorchCifar(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = torch.nn.Conv2d(3, 64, 3)
+            self.c2 = torch.nn.Conv2d(64, 128, 3)
+            self.c3 = torch.nn.Conv2d(128, 256, 3)
+            self.pool = torch.nn.MaxPool2d(2)
+            self.f1 = torch.nn.Linear(Hc * Hc * Cc, 128)
+            self.f2 = torch.nn.Linear(128, 256)
+            self.f3 = torch.nn.Linear(256, 10)
+
+        def forward(self, x):
+            for c in (self.c1, self.c2, self.c3):
+                x = self.pool(torch.relu(c(x)))
+            x = x.flatten(1)
+            x = torch.relu(self.f1(x))
+            x = torch.relu(self.f2(x))
+            return self.f3(x)
+
+    model = CNN_CIFAR()
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 32, 32, 3)))["params"]
+
+    tm = _TorchCifar()
+    with torch.no_grad():
+        # ONE conversion source of truth: the shared _to_torch_layout,
+        # parameterized by this model's flatten geometry (code review r3)
+        for name, mod in (("c1", "Conv_0"), ("c2", "Conv_1"),
+                          ("c3", "Conv_2"), ("f1", "Dense_0"),
+                          ("f2", "Dense_1"), ("f3", "Dense_2")):
+            getattr(tm, name).weight.copy_(torch.tensor(_to_torch_layout(
+                mod, "kernel", params[mod]["kernel"], Hc, Cc).copy()))
+            getattr(tm, name).bias.copy_(torch.tensor(_to_torch_layout(
+                mod, "bias", params[mod]["bias"], Hc, Cc)))
+
+    x = np.random.default_rng(4).normal(
+        size=(8, 32, 32, 3)).astype(np.float32)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                  train=False))
+    with torch.no_grad():
+        theirs = tm(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4)
 
 
 def test_flax_torch_forward_parity(setup):
