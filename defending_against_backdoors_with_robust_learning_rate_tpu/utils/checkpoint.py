@@ -74,6 +74,16 @@ def restore(ckpt_dir: str, params_like
         except ValueError:
             raise e
         state["cum_net_mov"] = np.asarray(0.0, np.float64)
-    key = jax.random.wrap_key_data(state["key"])
+    key_data = np.asarray(state["key"])
+    if key_data.shape != key_shape:
+        # threefry key data is [2] uint32, rbg is [4]: a shape mismatch means
+        # the checkpoint was written under a different PRNG bit generator —
+        # resuming would silently change every stream (train.py apply_rng_impl
+        # contract: a checkpoint resumes only under the impl that wrote it)
+        raise ValueError(
+            f"checkpoint {path} stores PRNG key data of shape "
+            f"{key_data.shape} but the active --rng_impl expects {key_shape};"
+            f" resume under the rng_impl that wrote the checkpoint")
+    key = jax.random.wrap_key_data(key_data)
     return (int(state["round"]), state["params"], key,
             float(state["cum_poison_acc"]), float(state["cum_net_mov"]))

@@ -65,6 +65,34 @@ def test_restore_structure_mismatch_reraises(tmp_path):
         ckpt.restore(d, {"renamed": jnp.zeros(4)})
 
 
+def test_cross_rng_impl_restore_fails_loudly(tmp_path):
+    """train.py's apply_rng_impl docstring promises a checkpoint "resumes
+    only under the impl that wrote it (restore fails loudly)": threefry key
+    data is [2] uint32, rbg is [4], so a cross-impl restore is a structural
+    mismatch orbax must reject — never a silent mis-resume."""
+    import pytest
+
+    prev = jax.config.jax_default_prng_impl
+    d = str(tmp_path / "ck")
+    params = {"a": jnp.arange(4.0)}
+    like = jax.tree_util.tree_map(jnp.zeros_like, params)
+    try:
+        jax.config.update("jax_default_prng_impl", "threefry2x32")
+        ckpt.save(d, 2, params, jax.random.PRNGKey(7), 1.0)
+        jax.config.update("jax_default_prng_impl", "rbg")
+        with pytest.raises(ValueError, match="rng_impl"):
+            ckpt.restore(d, like)
+        # and back under the writing impl it still restores fine
+        jax.config.update("jax_default_prng_impl", "threefry2x32")
+        rnd, _, k, _, _ = ckpt.restore(d, like)
+        assert rnd == 2
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(k)),
+            np.asarray(jax.random.key_data(jax.random.PRNGKey(7))))
+    finally:
+        jax.config.update("jax_default_prng_impl", prev)
+
+
 def test_latest_round_ignores_orbax_tmp_dirs(tmp_path):
     d = tmp_path / "ck"
     (d / "round_000005").mkdir(parents=True)
