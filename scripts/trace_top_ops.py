@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Op-level top time sinks of steady flagship rounds, from a jax.profiler
+trace (VERDICT r3 next #3).
+
+The ablation ladder (BENCH_NOTES.md r3, `profile_round.py --ablate`)
+decomposes the round by re-compiling it with one component removed at a
+time; its deltas overlap (removals change XLA's schedule), which caps
+attribution precision. This script is the other half: capture ONE op-level
+trace of steady-state rounds and print where XLA's own schedule says the
+time goes, so the two decompositions can be reconciled in BENCH_NOTES.md.
+
+Usage:
+  python scripts/trace_top_ops.py              # capture + parse (TPU)
+  python scripts/trace_top_ops.py --parse DIR  # re-parse an existing trace
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def capture(trace_dir: str, rounds: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        apply_rng_impl)
+
+    apply_rng_impl("auto")
+    # the bench.py flagship config, unchained: per-round dispatch gives the
+    # trace clean per-round boundaries (chained timing itself is within 1%
+    # of unchained at chain>=10, BENCH_NOTES.md r2 ladder)
+    cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
+                 num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
+                 synth_train_size=60000, synth_val_size=10000, seed=0)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat)
+    params = init_params(model, fed.train.images.shape[2:],
+                         jax.random.PRNGKey(0))
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    round_fn = make_round_fn(cfg, model, norm,
+                             jnp.asarray(fed.train.images),
+                             jnp.asarray(fed.train.labels),
+                             jnp.asarray(fed.train.sizes))
+    base_key = jax.random.PRNGKey(1)
+    print(f"[trace] device={jax.devices()[0]}", flush=True)
+    # warm up: compile + 2 steady rounds outside the capture window; round
+    # r's key is fold_in(base_key, r) — the driver loop's derivation
+    for r in range(3):
+        params, _ = round_fn(params, jax.random.fold_in(base_key, r))
+    jax.block_until_ready(params)
+    jax.profiler.start_trace(trace_dir)
+    for r in range(3, 3 + rounds):
+        params, _ = round_fn(params, jax.random.fold_in(base_key, r))
+    jax.block_until_ready(params)
+    jax.profiler.stop_trace()
+    with open(os.path.join(trace_dir, "capture_meta.json"), "w") as f:
+        json.dump({"rounds": rounds}, f)
+    print(f"[trace] captured {rounds} steady rounds -> {trace_dir}",
+          flush=True)
+
+
+GROUP_RE = re.compile(r"(\.(\d+|remat\d*|clone))+$")
+
+
+def group_name(name: str) -> str:
+    """fusion.123 -> fusion; convolution.4.remat -> convolution (group HLO
+    instances of the same op kind, including remat/clone-suffixed copies)."""
+    base = GROUP_RE.sub("", name)
+    return base or name
+
+
+def parse(trace_dir: str, top: int, rounds: int):
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not paths:
+        sys.exit(f"no *.trace.json.gz under {trace_dir}")
+    meta = os.path.join(trace_dir, "capture_meta.json")
+    if os.path.exists(meta):
+        with open(meta) as f:
+            rounds = json.load(f)["rounds"]
+    else:
+        print(f"[trace] no capture_meta.json — assuming --rounds={rounds} "
+              f"for the ms/round figure")
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # chrome-trace metadata: pid -> process name, (pid, tid) -> thread
+    # name; device lanes are the /device:TPU:* (or TPU:*) processes, host
+    # threads are everything else
+    pnames, tnames = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pnames[e["pid"]] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tnames[(e["pid"], e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+    dev_pids = {pid for pid, n in pnames.items()
+                if "tpu" in n.lower() or "/device" in n.lower()}
+    if not dev_pids:
+        print("[trace] NO device lanes in this trace (profiler saw only "
+              "host threads — the chip is behind the axon tunnel). "
+              f"Processes seen: {sorted(set(pnames.values()))}")
+        return None
+    # a device process exports several stacked lanes (e.g. an 'XLA Modules'
+    # envelope spanning the whole executable above per-op 'XLA Ops' rows);
+    # summing across all of them double-counts. Keep only the op-level
+    # lane(s) when identifiable.
+    op_tids = {(p, t) for (p, t), n in tnames.items()
+               if p in dev_pids and "op" in n.lower()
+               and "module" not in n.lower()}
+
+    def in_op_lane(e):
+        if (e["pid"], e.get("tid")) in op_tids:
+            return True
+        # no op-level lane metadata: fall back to excluding known
+        # envelope lanes by name
+        if not op_tids:
+            lane = tnames.get((e["pid"], e.get("tid")), "").lower()
+            return "module" not in lane and "step" not in lane
+        return False
+
+    per_op = collections.Counter()
+    per_group = collections.Counter()
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids \
+                or not in_op_lane(e):
+            continue
+        dur = float(e.get("dur", 0.0))  # microseconds
+        name = e.get("name", "?")
+        per_op[name] += dur
+        per_group[group_name(name)] += dur
+        total += dur
+    if total == 0.0:
+        print("[trace] device lanes exist but no duration events matched "
+              f"the op-level filter; lanes: "
+              f"{sorted(set(tnames.values()))}")
+        return None
+    lanes = (sorted(tnames[t] for t in op_tids)
+             or "(fallback: all non-module lanes)")
+    print(f"[trace] device processes: "
+          f"{sorted(pnames[p] for p in dev_pids)}; op lanes: {lanes}")
+    print(f"[trace] total device-op time in window: {total/1e3:.1f} ms "
+          f"({rounds} rounds -> {total/1e3/max(rounds,1):.1f} ms/round)")
+    print(f"\ntop {top} op groups (device time, % of captured op time):")
+    rows = []
+    for name, dur in per_group.most_common(top):
+        print(f"  {name:<44s} {dur/1e3:8.1f} ms  {100*dur/total:5.1f}%")
+        rows.append({"op": name, "ms": round(dur / 1e3, 1),
+                     "pct": round(100 * dur / total, 1)})
+    print(f"\ntop {top} individual ops:")
+    for name, dur in per_op.most_common(top):
+        print(f"  {name:<44s} {dur/1e3:8.1f} ms  {100*dur/total:5.1f}%")
+    return {"total_ms": round(total / 1e3, 1), "rounds": rounds,
+            "top_groups": rows}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parse", default="",
+                    help="parse an existing trace dir instead of capturing")
+    ap.add_argument("--trace_dir", default="/tmp/rlr_trace")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="steady rounds inside the capture window")
+    ap.add_argument("--top", type=int, default=12)
+    args = ap.parse_args()
+    tdir = args.parse or args.trace_dir
+    if not args.parse:
+        capture(tdir, args.rounds)
+    parse(tdir, args.top, args.rounds)
+
+
+if __name__ == "__main__":
+    main()
