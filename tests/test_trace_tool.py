@@ -1,0 +1,79 @@
+"""Unit tests for scripts/trace_top_ops.py's chrome-trace parser.
+
+Pins the three behaviors a bad parse would corrupt silently (r4 review):
+only the op-level device lane is summed (module envelopes would double-
+count), remat/clone-suffixed HLO names group with their base op, and the
+ms/round divisor comes from the recorded capture metadata, not the CLI
+default.
+"""
+
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from trace_top_ops import group_name, parse  # noqa: E402
+
+
+def _write_trace(tmp_path, events):
+    os.makedirs(tmp_path / "plugins" / "profile", exist_ok=True)
+    p = tmp_path / "plugins" / "profile" / "host.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return tmp_path
+
+
+def _meta(pid, pname, threads):
+    evs = [{"ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": pname}}]
+    for tid, tname in threads.items():
+        evs.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": tname}})
+    return evs
+
+
+def test_group_name_strips_instance_and_remat_suffixes():
+    assert group_name("fusion.123") == "fusion"
+    assert group_name("convolution.4.remat") == "convolution"
+    assert group_name("convolution.remat2") == "convolution"
+    assert group_name("all-reduce.1.clone") == "all-reduce"
+    assert group_name("copy") == "copy"
+
+
+def test_parse_counts_only_device_op_lane(tmp_path, capsys):
+    events = (
+        _meta(1, "/device:TPU:0", {10: "XLA Modules", 11: "XLA Ops"})
+        + _meta(2, "python host", {20: "main"})
+        + [
+            # module envelope spanning everything: must NOT be counted
+            {"ph": "X", "pid": 1, "tid": 10, "name": "jit_round",
+             "dur": 10000.0},
+            # op-level rows: the only thing counted
+            {"ph": "X", "pid": 1, "tid": 11, "name": "fusion.1",
+             "dur": 1000.0},
+            {"ph": "X", "pid": 1, "tid": 11, "name": "fusion.2",
+             "dur": 500.0},
+            {"ph": "X", "pid": 1, "tid": 11, "name": "convolution.3.remat",
+             "dur": 2500.0},
+            # host thread noise: never counted
+            {"ph": "X", "pid": 2, "tid": 20, "name": "dispatch",
+             "dur": 99999.0},
+        ])
+    tdir = _write_trace(tmp_path, events)
+    with open(tdir / "capture_meta.json", "w") as f:
+        json.dump({"rounds": 2}, f)
+    out = parse(str(tdir), top=5, rounds=3)   # CLI default 3 must lose
+    assert out["total_ms"] == 4.0             # 1000+500+2500 us, no 10000
+    assert out["rounds"] == 2                 # from capture_meta.json
+    groups = {r["op"]: r["ms"] for r in out["top_groups"]}
+    assert groups == {"fusion": 1.5, "convolution": 2.5}
+
+
+def test_parse_reports_missing_device_lanes(tmp_path, capsys):
+    events = _meta(2, "python host", {20: "main"}) + [
+        {"ph": "X", "pid": 2, "tid": 20, "name": "dispatch", "dur": 5.0}]
+    tdir = _write_trace(tmp_path, events)
+    assert parse(str(tdir), top=5, rounds=1) is None
+    assert "NO device lanes" in capsys.readouterr().out
