@@ -543,12 +543,24 @@ def main():
         m20 = r["milestones"].get(20, {})
         steady = s.get("steady_rounds_per_sec")
         steady_s = f"{steady:.2f}" if steady is not None else "—"
+        # † = stream-marginal (r3 18-cell ladder): converges only under the
+        # pinned threefry/seed-0 stream — flagged in the table, not just
+        # the prose above
+        marginal = "†" if r["name"] == "cifar10-dba-rlr" else ""
         lines.append(
-            f"| {r['name']} | {s.get('round')} | {fmt(s.get('val_acc'))} | "
+            f"| {r['name']}{marginal} | {s.get('round')} | "
+            f"{fmt(s.get('val_acc'))} | "
             f"{fmt(s.get('poison_acc'))} | {fmt(m20.get('val_acc'))} | "
             f"{fmt(m20.get('poison_acc'))} | "
             f"{s.get('rounds_per_sec', 0):.2f} | {steady_s} | "
             f"{r['wall_s']}s |")
+
+    lines += [
+        "",
+        "† stream-marginal (BENCH_NOTES.md r3 probe ladder): this defended "
+        "row converges only under its pinned threefry/seed-0 stream; rbg "
+        "streams collapse it. Re-check if the proxy task ever changes.",
+    ]
 
     # seed-robustness table (VERDICT r3 next #6): seed-suffixed reruns of
     # the cheap canonical rows, aggregated as mean (min–max) across streams
