@@ -61,6 +61,26 @@ def test_chained_matches_per_round_dispatch():
     assert stacked["sampled"].shape == (n, cfg.agents_per_round)
 
 
+def test_chained_matches_per_round_with_clip_and_noise():
+    """The r4 clip+noise sweep row runs chained: per-batch PGD projection
+    and the server's Gaussian noise (k_noise split from the round key) must
+    derive identically inside the scan and in per-round dispatch."""
+    cfg, model, params, norm, arrays = _setup()
+    cfg = cfg.replace(clip=1.0, noise=0.01)
+    base_key = jax.random.PRNGKey(11)
+    n = 3
+
+    round_fn = make_round_fn(cfg, model, norm, *arrays)
+    p_seq = params
+    for r in range(1, n + 1):
+        p_seq, _ = round_fn(p_seq, jax.random.fold_in(base_key, r))
+
+    chained = make_chained_round_fn(cfg, model, norm, *arrays)
+    p_chain, _ = chained(params, base_key, jnp.arange(1, n + 1))
+
+    _assert_trees_close(p_seq, p_chain, atol=1e-6, rtol=1e-6)
+
+
 def test_sharded_chained_matches_sharded_per_round():
     cfg, model, params, norm, arrays = _setup(num_agents=8)
     mesh = make_mesh(4)
