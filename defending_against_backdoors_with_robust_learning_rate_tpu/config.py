@@ -35,7 +35,7 @@ class Config:
     agent_frac: float = 1.0         # C, fraction of agents sampled per round
     num_corrupt: int = 0            # first num_corrupt agent ids are malicious
     rounds: int = 200               # R communication rounds
-    aggr: str = "avg"               # avg | comed | sign | trmean | krum
+    aggr: str = "avg"               # avg | comed | sign | trmean | krum | rfa
     local_ep: int = 2               # E local epochs
     bs: int = 256                   # B local batch size
     client_lr: float = 0.1
@@ -159,7 +159,8 @@ def _add_reference_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--rounds", type=int, default=d.rounds,
                    help="number of communication rounds:R")
     p.add_argument("--aggr", type=str, default=d.aggr,
-                   help="aggregation function (avg|comed|sign|trmean|krum)")
+                   help="aggregation function "
+                        "(avg|comed|sign|trmean|krum|rfa)")
     p.add_argument("--local_ep", type=int, default=d.local_ep,
                    help="number of local epochs:E")
     p.add_argument("--bs", type=int, default=d.bs, help="local batch size: B")

@@ -9,9 +9,12 @@ section 5.8:
     agg_sign / RLR   -> psum of per-coordinate sign sums         (ICI)
     agg_comed        -> all_to_all transpose to param-sharded layout,
                         local median, all_gather of median chunks
+    agg_trmean       -> same transpose, local sort + trimmed-band mean
     agg_krum         -> all_to_all transpose, chunk-partial pairwise
                         distances psummed to the full [m, m] matrix,
                         winner's chunks re-assembled by all_gather
+    agg_rfa          -> replicated Weiszfeld iterate; two psums per
+                        iteration (local-block distances, no transpose)
 
 comed/krum deliberately avoid `all_gather`ing the full [m, n_params]
 update matrix (SURVEY.md 7.3.1: ~1 GiB/device at 256 agents x 1M params).
@@ -39,7 +42,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import 
     make_local_train)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
-    apply_aggregate, gaussian_noise_like, sq_dist_accum, trmean_k)
+    RFA_EPS, RFA_ITERS, agent_sq_dists, apply_aggregate, gaussian_noise_like,
+    sq_dist_accum, trmean_k)
 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
     AGENTS_AXIS)
 
@@ -117,6 +121,27 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
         agg = jax.tree_util.tree_unflatten(treedef, [
             _from_param_shard(chunk[best], L, u.shape[1:])
             for (chunk, L), u in zip(shards, leaves)])
+    elif cfg.aggr == "rfa":
+        # geometric median (smoothed Weiszfeld, ops/aggregate.agg_rfa
+        # semantics): the iterate v is replicated; per-agent distances are
+        # computed on each device's local block, so every iteration costs
+        # exactly two psums (weighted sum + weight total) over ICI — no
+        # transpose needed
+        m = cfg.agents_per_round
+        v = tree.map(
+            lambda u: jax.lax.psum(jnp.sum(u.astype(jnp.float32), axis=0),
+                                   ax) / m, updates)
+        for _ in range(RFA_ITERS):
+            w = 1.0 / jnp.maximum(jnp.sqrt(agent_sq_dists(updates, v)),
+                                  RFA_EPS)
+            wsum = jax.lax.psum(jnp.sum(w), ax)
+
+            def leaf(u, w=w, wsum=wsum):
+                wshape = (-1,) + (1,) * (u.ndim - 1)
+                return jax.lax.psum(
+                    jnp.sum(u * w.reshape(wshape), axis=0), ax) / wsum
+            v = tree.map(leaf, updates)
+        agg = v
     else:
         raise ValueError(f"unknown aggr {cfg.aggr!r}")
     if cfg.noise > 0:

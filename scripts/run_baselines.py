@@ -237,6 +237,8 @@ def main():
              Config(num_corrupt=1, poison_frac=0.5, aggr="trmean", **fm)),
             ("fmnist-attack-krum",
              Config(num_corrupt=1, poison_frac=0.5, aggr="krum", **fm)),
+            ("fmnist-attack-rfa",
+             Config(num_corrupt=1, poison_frac=0.5, aggr="rfa", **fm)),
             # client PGD projection + server DP noise end-to-end (VERDICT
             # r3 next #4; ref src/agent.py:54-60 + src/aggregation.py:34-35)
             ("fmnist-attack-rlr-clipnoise",
@@ -367,6 +369,7 @@ def main():
              "fmnist-attack-comed", "fmnist-attack-comed-rlr",
              "fmnist-attack-sign", "fmnist-attack-sign-rlr",
              "fmnist-attack-trmean", "fmnist-attack-krum",
+             "fmnist-attack-rfa",
              "fmnist-attack-rlr-clipnoise",
              "cifar10-dba-attack", "cifar10-dba-rlr",
              "cifar10-resnet9-dba-attack", "cifar10-resnet9-dba-rlr",

@@ -216,3 +216,53 @@ def test_noise_added_when_enabled():
     out = aggregate_updates(u, jnp.ones((4,)), cfg, jax.random.PRNGKey(0))
     std = float(jnp.std(out["w"]))
     assert 0.3 < std < 0.7      # N(0, noise*clip=0.5)
+
+
+def _np_rfa(stack, iters, eps):
+    """Pillutla et al. 2022, Algorithm 1 (smoothed Weiszfeld): start at the
+    mean; reweight points by 1/max(||u_k - v||, eps) and take the weighted
+    mean, a fixed number of iterations. Float64, independent of
+    ops/aggregate.py."""
+    rows = np.asarray(stack, np.float64)
+    v = rows.mean(axis=0)
+    for _ in range(iters):
+        w = 1.0 / np.maximum(np.linalg.norm(rows - v[None], axis=1), eps)
+        v = (rows * w[:, None]).sum(axis=0) / w.sum()
+    return v
+
+
+def test_agg_rfa_matches_paper_math_on_random_stacks():
+    """agg_rfa (geometric median, smoothed Weiszfeld) held to the same
+    extension parity bar as trmean/krum: equals the from-the-paper numpy
+    implementation on random multi-leaf stacks (distances computed across
+    ALL leaves jointly)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+        RFA_EPS, RFA_ITERS, agg_rfa)
+    m = 7
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        u = {"w": jnp.asarray(rng.normal(size=(m, 4, 3)).astype(np.float32)),
+             "b": jnp.asarray(rng.normal(size=(m, 5)).astype(np.float32))}
+        flat = np.concatenate(
+            [np.asarray(u["w"]).reshape(m, -1), np.asarray(u["b"])], axis=1)
+        want = _np_rfa(flat, RFA_ITERS, RFA_EPS)
+        out = agg_rfa(u)
+        got = np.concatenate([np.asarray(out["w"]).reshape(-1),
+                              np.asarray(out["b"]).reshape(-1)])
+        np.testing.assert_allclose(got, want.reshape(-1), rtol=1e-4,
+                                   atol=1e-6, err_msg=f"seed={seed}")
+
+
+def test_agg_rfa_resists_outlier():
+    """The geometric median must stay near the honest cluster when one
+    update is wildly corrupted (the property that makes it a defense)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+        agg_rfa)
+    rng = np.random.default_rng(4)
+    honest = rng.normal(0, 0.1, size=(6, 30)).astype(np.float32)
+    outlier = np.full((1, 30), 100.0, np.float32)
+    u = {"w": jnp.asarray(np.concatenate([honest, outlier]))}
+    out = np.asarray(agg_rfa(u)["w"])
+    mean = np.concatenate([honest, outlier]).mean(0)
+    # the plain mean is dragged to ~14; RFA stays near the honest cloud
+    assert np.abs(out).max() < 1.0 < np.abs(mean).max()

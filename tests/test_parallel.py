@@ -43,7 +43,7 @@ def _setup(aggr, num_corrupt=1):
     return cfg, model, params, norm, arrays
 
 
-@pytest.mark.parametrize("aggr", ["avg", "comed", "sign", "trmean", "krum"])
+@pytest.mark.parametrize("aggr", ["avg", "comed", "sign", "trmean", "krum", "rfa"])
 def test_sharded_round_matches_vmap_round(aggr):
     assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
     cfg, model, params, norm, arrays = _setup(aggr)
