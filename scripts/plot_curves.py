@@ -32,6 +32,19 @@ FAMILY_COLOR = {
 }
 VARIANT_STYLE = {"clean": ":", "attack": "-", "rlr": "--"}
 
+# the two headline figures regenerate the reference's performance.png /
+# poison_acc.png and stay readable only at the canonical row set; the r4
+# families (aggregator coverage, extra patterns, clip+noise) and seed-matrix
+# reruns live in RESULTS.md tables, not these plots
+CANONICAL = {
+    "fmnist-clean", "fmnist-attack", "fmnist-attack-rlr",
+    "fmnist-attack-copyright", "fmnist-attack-copyright-rlr",
+    "cifar10-dba-attack", "cifar10-dba-rlr",
+    "cifar10-resnet9-dba-attack", "cifar10-resnet9-dba-rlr",
+    "fedemnist-attack", "fedemnist-attack-rlr",
+    "fedemnist-full-attack", "fedemnist-full-rlr",
+}
+
 
 def split_name(name: str):
     """'cifar10-resnet9-dba-rlr' -> ('cifar10-resnet9', 'rlr')."""
@@ -71,6 +84,8 @@ def main():
     for fname, tag, title in figures:
         fig, ax = plt.subplots(figsize=(7, 4.2), dpi=150)
         for r in results:
+            if r["name"] not in CANONICAL:
+                continue
             curves = r.get("curves")
             if not curves:
                 continue
