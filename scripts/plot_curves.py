@@ -72,6 +72,7 @@ def main():
     except ImportError:
         sys.exit("matplotlib is not available in this environment")
 
+    os.makedirs(args.outdir, exist_ok=True)
     with open(args.results) as f:
         results = json.load(f)
 
