@@ -26,8 +26,11 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def capture(trace_dir: str, rounds: int) -> None:
+def capture(trace_dir: str, rounds: int, platform: str = "",
+            smoke: bool = False) -> None:
     import jax
+    if platform:
+        jax.config.update("jax_platforms", platform)
     import jax.numpy as jnp
 
     from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
@@ -49,6 +52,11 @@ def capture(trace_dir: str, rounds: int) -> None:
     cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
                  num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
                  synth_train_size=60000, synth_val_size=10000, seed=0)
+    if smoke:
+        # tiny shapes: validates capture->parse end-to-end on any backend
+        # (timings meaningless; XLA:CPU runs scan convs on a slow path)
+        cfg = cfg.replace(bs=32, synth_train_size=640, synth_val_size=128,
+                          data_dir="/nonexistent_use_synthetic")
     fed = get_federated_data(cfg)
     model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat)
     params = init_params(model, fed.train.images.shape[2:],
@@ -182,10 +190,15 @@ def main():
     ap.add_argument("--rounds", type=int, default=3,
                     help="steady rounds inside the capture window")
     ap.add_argument("--top", type=int, default=12)
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (e.g. cpu)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes — validates the capture->parse "
+                         "pipeline without the full config")
     args = ap.parse_args()
     tdir = args.parse or args.trace_dir
     if not args.parse:
-        capture(tdir, args.rounds)
+        capture(tdir, args.rounds, args.platform, args.smoke)
     parse(tdir, args.top, args.rounds)
 
 
