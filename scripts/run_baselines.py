@@ -532,6 +532,24 @@ def main():
         "production path itself (PNG load, resize, uint8 wraparound "
         "stamp, per-agent slice) is what the rows certify.",
         "",
+        "Row families beyond the reference's canonical triples (all fmnist "
+        "attack shapes unless noted): `*-square/-apple` complete the four "
+        "`add_pattern_bd` trojan geometries end-to-end (square ref "
+        "utils.py:227-230; apple utils.py:237-242 via the cv2 watermark "
+        "path — real reference PNG under RLR_ASSET_DIR, else the "
+        "deterministic stand-in). `*-comed/-sign` run the reference's "
+        "other two server rules through full TPU experiments "
+        "(aggregation.py:66-75); `*-trmean/-krum/-rfa` do the same for "
+        "the framework's extension aggregators (trim/select count = "
+        "num_corrupt). sign uses the documented server_lr calibration "
+        "(SIGN_SERVER_LR in this script — the reference's 1.0 default "
+        "steps every coordinate by +-1 and no sign experiment exists in "
+        "runner.sh to match). `*-rlr-clipnoise` exercises client-side "
+        "per-batch PGD projection (clip) plus server Gaussian noise "
+        "end-to-end (agent.py:54-60, aggregation.py:34-35). Seed-matrix "
+        "reruns (`--seeds`) render in the Seed robustness section, not "
+        "this table.",
+        "",
         "| config | rounds | val acc | poison acc | val@20 | poison@20 |"
         " r/s (wall) | r/s (steady) | wall |",
         "|---|---|---|---|---|---|---|---|---|",
