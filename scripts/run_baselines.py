@@ -102,8 +102,9 @@ def main():
                     help="tiny shapes for smoke-testing this script")
     ap.add_argument("--out", default="RESULTS.md")
     ap.add_argument("--only", default="",
-                    help="substring filter: run only matching configs and "
-                         "merge into the existing results.json")
+                    help="substring filter (comma-separated alternatives): "
+                         "run only matching configs and merge into the "
+                         "existing results.json")
     ap.add_argument("--regen", action="store_true",
                     help="rewrite RESULTS.md from the existing results.json "
                          "without running anything (no backend touched)")
@@ -358,7 +359,9 @@ def main():
     if args.regen:
         configs = []
     elif args.only:
-        configs = [(n, c) for n, c in configs if args.only in n]
+        pats = [p for p in args.only.split(",") if p]
+        configs = [(n, c) for n, c in configs
+                   if any(p in n for p in pats)]
         if not configs:
             sys.exit(f"--only {args.only!r} matches no config "
                      f"(note: --quick builds only the fmnist triple)")
