@@ -266,3 +266,19 @@ def test_agg_rfa_resists_outlier():
     mean = np.concatenate([honest, outlier]).mean(0)
     # the plain mean is dragged to ~14; RFA stays near the honest cloud
     assert np.abs(out).max() < 1.0 < np.abs(mean).max()
+
+
+def test_aggregate_updates_dispatches_every_rule():
+    """The dispatch table accepts every documented --aggr value and rejects
+    unknown ones (config.py: avg|comed|sign|trmean|krum|rfa)."""
+    import pytest
+    rng = np.random.default_rng(9)
+    u = {"w": jnp.asarray(rng.normal(size=(5, 12)).astype(np.float32))}
+    sizes = jnp.asarray([3.0, 1.0, 2.0, 2.0, 4.0])
+    for aggr in ("avg", "comed", "sign", "trmean", "krum", "rfa"):
+        cfg = Config(aggr=aggr, num_corrupt=1)
+        out = aggregate_updates(u, sizes, cfg, jax.random.PRNGKey(0))
+        assert np.isfinite(np.asarray(out["w"])).all(), aggr
+    with pytest.raises(ValueError, match="unknown aggr"):
+        aggregate_updates(u, sizes, Config(aggr="bogus"),
+                          jax.random.PRNGKey(0))
