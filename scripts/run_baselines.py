@@ -120,6 +120,19 @@ def main():
     # where training survives the defense — the paper's regime.
     ap.add_argument("--hardness_cifar", type=float, default=0.25)
     ap.add_argument("--hardness_fedemnist", type=float, default=0.4)
+    ap.add_argument("--sign_server_lr", type=float, default=SIGN_SERVER_LR,
+                    help="signSGD step size for the sign rows (documented "
+                         "calibration; see SIGN_SERVER_LR)")
+    ap.add_argument("--sign_data_dir", default="",
+                    help="override data_dir for the sign rows (per-rule "
+                         "hardness needs its own on-disk file set, e.g. "
+                         "./data_h025 from make_dataset_files.py)")
+    ap.add_argument("--sign_hardness", type=float, default=-1.0,
+                    help="synth_hardness recorded for the sign rows when "
+                         "--sign_data_dir is set (<0 keeps the fmnist "
+                         "default)")
+    ap.add_argument("--clipnoise_noise", type=float, default=CLIPNOISE_NOISE,
+                    help="noise multiplier for the clip+noise row")
     ap.add_argument("--seeds", default="",
                     help="comma-separated extra seeds (e.g. 1,2): adds "
                          "seed-suffixed variants (name@sN) of the cheap "
@@ -221,6 +234,15 @@ def main():
         # 71-75 + 38-40), so the reference's server_lr=1 default would step
         # each of the 1.2M params by +-1 — SIGN_SERVER_LR below is the
         # probed calibration (see BENCH_NOTES.md r4).
+        # sign rows may need their own per-rule hardness (sign-majority is
+        # a far weaker optimizer than FedAvg — same principle as the
+        # per-dataset hardness above); --sign_data_dir points at a file
+        # set generated at that hardness
+        sfm = dict(fm)
+        if args.sign_data_dir:
+            sfm["data_dir"] = args.sign_data_dir
+            if args.sign_hardness >= 0:
+                sfm["synth_hardness"] = args.sign_hardness
         configs += [
             ("fmnist-attack-comed",
              Config(num_corrupt=1, poison_frac=0.5, aggr="comed", **fm)),
@@ -229,10 +251,11 @@ def main():
                     robustLR_threshold=4, **fm)),
             ("fmnist-attack-sign",
              Config(num_corrupt=1, poison_frac=0.5, aggr="sign",
-                    server_lr=SIGN_SERVER_LR, **fm)),
+                    server_lr=args.sign_server_lr, **sfm)),
             ("fmnist-attack-sign-rlr",
              Config(num_corrupt=1, poison_frac=0.5, aggr="sign",
-                    server_lr=SIGN_SERVER_LR, robustLR_threshold=4, **fm)),
+                    server_lr=args.sign_server_lr, robustLR_threshold=4,
+                    **sfm)),
             # trim/select count = num_corrupt for both extensions
             ("fmnist-attack-trmean",
              Config(num_corrupt=1, poison_frac=0.5, aggr="trmean", **fm)),
@@ -244,7 +267,7 @@ def main():
             # r3 next #4; ref src/agent.py:54-60 + src/aggregation.py:34-35)
             ("fmnist-attack-rlr-clipnoise",
              Config(num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
-                    clip=CLIPNOISE_CLIP, noise=CLIPNOISE_NOISE, **fm)),
+                    clip=CLIPNOISE_CLIP, noise=args.clipnoise_noise, **fm)),
         ]
         # reference src/runner.sh:23-28 cifar10 DBA (40 agents, 4 corrupt,
         # thr=8) — scaled rounds; ResNet-9 is the BASELINE.json configs[3]
