@@ -200,6 +200,16 @@ def main():
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         get_model, init_params)
 
+    # CPU fallback must actually GET its reduced shapes: on-disk dataset
+    # files (full 60k/50k geometry) override synth_* sizes, and the full
+    # config on XLA:CPU's conv-in-while slow path runs for hours (r4 find —
+    # the driver's round-end bench would wedge). Point the fallback at a
+    # nonexistent data dir so the synthetic generator's sizes apply.
+    extra = {"use_pallas": args.use_pallas}
+    if args.dtype:
+        extra["dtype"] = args.dtype
+    if cpu_fallback:
+        extra["data_dir"] = "/nonexistent_use_synthetic_reduced"
     if args.bench_config == "resnet9":
         # BASELINE.json configs[3] / RESULTS.md cifar10-resnet9-dba-rlr:
         # the MXU-bound north-star shape (VERDICT r3 next #1 — measure its
@@ -209,16 +219,12 @@ def main():
                      robustLR_threshold=8, arch="resnet9", remat=True,
                      agent_chunk=10,
                      synth_train_size=(5000 if cpu_fallback else 50000),
-                     synth_val_size=10000, seed=0,
-                     use_pallas=args.use_pallas,
-                     **({"dtype": args.dtype} if args.dtype else {}))
+                     synth_val_size=10000, seed=0, **extra)
     else:
         cfg = Config(data="fmnist", num_agents=10, local_ep=2, bs=256,
                      num_corrupt=1, poison_frac=0.5, robustLR_threshold=4,
                      synth_train_size=(6000 if cpu_fallback else 60000),
-                     synth_val_size=10000, seed=0,
-                     use_pallas=args.use_pallas,
-                     **({"dtype": args.dtype} if args.dtype else {}))
+                     synth_val_size=10000, seed=0, **extra)
     device = jax.devices()[0]
     log(f"[bench] devices: {jax.devices()}")
 
