@@ -111,10 +111,10 @@ def build_stamp(data: str, pattern_type: str, agent_idx: int = -1,
                 data_dir: str = "./data") -> Stamp:
     """Build the (mask, value, mode) stamp for a dataset/pattern/DBA-slice combo.
 
-    `agent_idx=-1` is the full (unpartitioned) pattern — used for honest... no:
-    used for the poisoned *validation* set (src/federated.py:42-45); training
-    poisoning passes the corrupt agent's id (src/agent.py:19-25), which only
-    changes the geometry for cifar10 'plus' (the DBA split, utils.py:202-224).
+    `agent_idx=-1` is the full (unpartitioned) pattern, used for the poisoned
+    *validation* set (src/federated.py:42-45); training poisoning passes the
+    corrupt agent's id (src/agent.py:19-25), which only changes the geometry
+    for cifar10 'plus' (the DBA split, utils.py:202-224).
     """
     if data == "fmnist":
         h = w = 28

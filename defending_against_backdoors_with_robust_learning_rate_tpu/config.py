@@ -91,6 +91,10 @@ class Config:
                                     # model's forward (ResNet-9): backward
                                     # recomputes activations instead of
                                     # stashing them (exact, saves HBM)
+    remat_policy: str = "block"     # block: recompute everything per block;
+                                    # conv: save the conv (MXU) outputs and
+                                    # recompute only the elementwise tail
+                                    # (~3x saved bytes, no conv recompute)
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -240,6 +244,11 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    help="blockwise rematerialization of the model forward "
                         "(ResNet-9): recompute activations in backward "
                         "instead of stashing them — exact, saves HBM")
+    p.add_argument("--remat_policy", type=str, default=d.remat_policy,
+                   choices=("block", "conv"),
+                   help="remat flavor: block = recompute everything; conv "
+                        "= save conv (MXU) outputs, recompute only the "
+                        "elementwise tail")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--log_dir", type=str, default=d.log_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)

@@ -14,14 +14,17 @@ _DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 
 def get_model(data: str, arch: str = "cnn", dtype: str = "f32",
-              n_classes: int = 10, remat: bool = False):
+              n_classes: int = 10, remat: bool = False,
+              remat_policy: str = "block"):
     """fmnist/fedemnist -> CNN_MNIST; cifar10 -> CNN_CIFAR (src/models.py:4-8);
     arch='resnet9' selects the BASELINE north-star ResNet-9 extension.
-    `remat` enables blockwise rematerialization (ResNet-9 only; the small
-    CNNs' activations never pressure HBM)."""
+    `remat` enables rematerialization (ResNet-9 only; the small CNNs'
+    activations never pressure HBM); `remat_policy` picks full blockwise
+    ("block") or selective save-conv-outputs ("conv") recompute."""
     dt = _DTYPES[dtype]
     if arch == "resnet9":
-        return ResNet9(n_classes=n_classes, dtype=dt, remat=remat)
+        return ResNet9(n_classes=n_classes, dtype=dt, remat=remat,
+                       remat_policy=remat_policy)
     if data in ("fmnist", "fedemnist", "synthetic"):
         return CNN_MNIST(n_classes=n_classes, dtype=dt)
     if data == "cifar10":

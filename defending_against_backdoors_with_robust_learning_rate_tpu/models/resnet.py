@@ -19,7 +19,9 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 class ConvGN(nn.Module):
@@ -31,6 +33,9 @@ class ConvGN(nn.Module):
     def __call__(self, x):
         x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype)(x)
+        # names the MXU output for the selective remat policy below; a
+        # transparent no-op under no remat / full blockwise remat
+        x = checkpoint_name(x, "conv_out")
         x = nn.GroupNorm(num_groups=min(32, self.width),
                          dtype=self.dtype)(x)
         x = nn.relu(x)
@@ -59,11 +64,25 @@ class ResNet9(nn.Module):
     # needed when many agents' ResNet batches are vmapped on one chip
     # (40 agents x bs 256 stashes ~19 GB un-remated, > v5e's 16 GB HBM).
     remat: bool = False
+    # remat_policy (active only when remat=True):
+    #   "block" — save block inputs only, recompute EVERYTHING in backward
+    #             (the r4-measured +33.3% forward-recompute tax)
+    #   "conv"  — selective: additionally save the named conv (MXU) outputs
+    #             and recompute only the cheap elementwise tail (GN, relu,
+    #             pool) — ~3x the saved bytes of "block", none of the conv
+    #             recompute FLOPs (VERDICT r4 next #4)
+    remat_policy: str = "block"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        Conv = nn.remat(ConvGN) if self.remat else ConvGN
-        Res = nn.remat(Residual) if self.remat else Residual
+        if self.remat and self.remat_policy == "conv":
+            pol = jax.checkpoint_policies.save_only_these_names("conv_out")
+            Conv = nn.remat(ConvGN, policy=pol)
+            Res = nn.remat(Residual, policy=pol)
+        elif self.remat:
+            Conv, Res = nn.remat(ConvGN), nn.remat(Residual)
+        else:
+            Conv, Res = ConvGN, Residual
         # explicit names: nn.remat prefixes auto-generated module names
         # ("CheckpointConvGN_0"), which would fork the param tree between
         # remat on/off — same tree means checkpoints interchange freely

@@ -95,7 +95,8 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         print(f"[data] {cfg.data} files not found under {cfg.data_dir!r}; "
               f"using the deterministic synthetic fallback")
 
-    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat,
+                      remat_policy=cfg.remat_policy)
     params = init_params(model, fed.train.images.shape[2:],
                          jax.random.PRNGKey(cfg.seed))
     print(f"[model] {type(model).__name__}: {param_count(params):,} params")

@@ -98,6 +98,9 @@ def main():
         cells = [
             ("clipnoise-n0.001", Config(noise=0.001, **cb)),
             ("clipnoise-n0.01", Config(noise=0.01, **cb)),
+            # the close-out fallback level — probed too, so the decision
+            # logic never runs a judge-facing row at an unvalidated noise
+            ("clipnoise-n0.0001", Config(noise=0.0001, **cb)),
         ]
     _run_cells(cells, args.out)
 

@@ -29,30 +29,52 @@ fi
 [ -d data_h025 ] || python scripts/make_dataset_files.py --data_dir=./data_h025 --only fmnist --hardness=0.25 >>"$LOG" 2>&1
 [ -d data_h035 ] || python scripts/make_dataset_files.py --data_dir=./data_h035 --only fmnist --hardness=0.35 >>"$LOG" 2>&1
 
+# probe outputs are written to a .tmp and moved into place only when the
+# battery completes — a partial file from an aborted run can't silently
+# drive the calibration pick, and a complete file from a prior run is
+# reused as-is (idempotent reruns)
 if [ ! -s "$CN_OUT" ]; then
     say "clipnoise probe battery"
-    python scripts/probe_calibrations.py clipnoise --out "$CN_OUT" >>"$LOG" 2>&1 || say "WARN clipnoise probes rc=$?"
+    rm -f "$CN_OUT.tmp"
+    if python scripts/probe_calibrations.py clipnoise --out "$CN_OUT.tmp" >>"$LOG" 2>&1; then
+        mv "$CN_OUT.tmp" "$CN_OUT"
+    else
+        say "WARN clipnoise probes rc=$? (partial output left in $CN_OUT.tmp)"
+    fi
 fi
 if [ ! -s "$SIGN_OUT" ]; then
     say "sign probe battery"
-    python scripts/probe_calibrations.py sign --out "$SIGN_OUT" >>"$LOG" 2>&1 || say "WARN sign probes rc=$?"
+    rm -f "$SIGN_OUT.tmp"
+    if python scripts/probe_calibrations.py sign --out "$SIGN_OUT.tmp" >>"$LOG" 2>&1; then
+        mv "$SIGN_OUT.tmp" "$SIGN_OUT"
+    else
+        say "WARN sign probes rc=$? (partial output left in $SIGN_OUT.tmp)"
+    fi
 fi
 
 # --- decide sign calibration from the ladder ---------------------------
+# preference order, stated explicitly: (1) the canonical-hardness 200-round
+# cell if it passes — the judge-facing sign rows run 200 rounds at that
+# hardness, so it is the most representative probe; (2) otherwise the BEST
+# of the 60-round reduced-hardness cells (max final val >= 0.3) — these
+# share a training budget, so max-val comparison between them is fair
 pick=$(python - "$SIGN_OUT" <<'PY'
 import json, sys
-best = ""
+rows = {}
 try:
     for line in open(sys.argv[1]):
         if not line.startswith("PROBE"):
             continue
         _, name, payload = line.split(" ", 2)
-        if (json.loads(payload)["final"]["val"] or 0) >= 0.3:
-            best = name
-            break
+        rows[name] = json.loads(payload)["final"]["val"] or 0
 except FileNotFoundError:
     pass
-print(best)
+if rows.get("sign-h05-lr0.001-r200", 0) >= 0.3:
+    print("sign-h05-lr0.001-r200")
+else:
+    short = {n: v for n, v in rows.items()
+             if n != "sign-h05-lr0.001-r200" and v >= 0.3}
+    print(max(short, key=short.get) if short else "")
 PY
 )
 case "$pick" in
@@ -65,7 +87,10 @@ esac
 say "sign pick: ${pick:-none} -> $SIGN_ARGS"
 
 # --- decide clip+noise level ------------------------------------------
-CN=$(python - "$CN_OUT" <<'PY'
+# prefer the strongest noise that still trains; every candidate level
+# (including the 0.0001 fallback) is in the probe battery, so the chosen
+# level is normally validated — WARN loudly if even the floor failed
+CN_DECISION=$(python - "$CN_OUT" <<'PY'
 import json, sys
 rows = {}
 try:
@@ -76,20 +101,22 @@ try:
         rows[name] = json.loads(payload)["final"]["val"] or 0
 except FileNotFoundError:
     pass
-# prefer the strongest noise that still trains
-if rows.get("clipnoise-n0.01", 0) >= 0.5:
-    print("0.01")
-elif rows.get("clipnoise-n0.001", 0) >= 0.5:
-    print("0.001")
+for level in ("0.01", "0.001", "0.0001"):
+    if rows.get(f"clipnoise-n{level}", 0) >= 0.5:
+        print(f"{level} VALIDATED")
+        break
 else:
-    print("0.0001")
+    print("0.0001 UNVALIDATED")
 PY
 )
-say "clipnoise noise: $CN"
+CN=${CN_DECISION% *}
+say "clipnoise noise: $CN_DECISION"
+[ "${CN_DECISION#* }" = "UNVALIDATED" ] && \
+  say "WARN: no probed noise level (incl. the 0.0001 floor) reached val 0.5 — the judge-facing clipnoise row runs at an UNVALIDATED level"
 
-say "sweep: r4 row families"
+say "sweep: r4 row families (+ the r5 bf16 ResNet-9 row)"
 python scripts/run_baselines.py $SIGN_ARGS --clipnoise_noise "$CN" \
-  --only square,apple,comed,sign,trmean,krum,rfa,clipnoise >>"$LOG" 2>&1 \
+  --only square,apple,comed,sign,trmean,krum,rfa,clipnoise,bf16 >>"$LOG" 2>&1 \
   && say "new rows done" || say "WARN new rows rc=$?"
 
 say "sweep: seed matrix"

@@ -1,15 +1,18 @@
 #!/usr/bin/env bash
 # Detachable watcher: probe the TPU every ~9 min; when it answers, run the
-# full measurement session (scripts/tpu_session.sh). Writes progress to
-# logs/tpu_watch.log. Start with:
+# round's measurement session (default scripts/tpu_session_r5.sh; pass a
+# different session script as $1). Writes progress to logs/tpu_watch.log.
+# Start with:
 #   nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 &
 cd "$(dirname "$0")/.."
+SESSION=${1:-scripts/tpu_session_r5.sh}
 mkdir -p logs
 W=logs/tpu_watch.log
-for i in $(seq 1 60); do
+[ -f "$SESSION" ] || { echo "[watcher] session script $SESSION missing — refusing to burn the TPU-alive trigger on a no-op" >>"$W"; exit 1; }
+for i in $(seq 1 70); do
   if timeout 45 python -c "import jax; jax.devices()" >>"$W" 2>&1; then
-    echo "[watcher] TPU alive at $(date); launching session" >>"$W"
-    bash scripts/tpu_session.sh >>"$W" 2>&1
+    echo "[watcher] TPU alive at $(date); launching $SESSION" >>"$W"
+    bash "$SESSION" >>"$W" 2>&1
     echo "[watcher] session rc=$? at $(date)" >>"$W"
     exit 0
   fi
