@@ -58,7 +58,8 @@ def capture(trace_dir: str, rounds: int, platform: str = "",
         cfg = cfg.replace(bs=32, synth_train_size=640, synth_val_size=128,
                           data_dir="/nonexistent_use_synthetic")
     fed = get_federated_data(cfg)
-    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat,
+                      remat_policy=cfg.remat_policy)
     params = init_params(model, fed.train.images.shape[2:],
                          jax.random.PRNGKey(0))
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
@@ -106,7 +107,14 @@ def parse(trace_dir: str, top: int, rounds: int):
     else:
         print(f"[trace] no capture_meta.json — assuming --rounds={rounds} "
               f"for the ms/round figure")
-    with gzip.open(paths[-1], "rt") as f:
+    chosen = max(paths, key=os.path.getmtime)
+    if len(paths) > 1:
+        # one .trace.json.gz per host per profiler run; on this one-host
+        # setup multiple files mean multiple capture runs — parse the
+        # newest and say so (merging across runs would mix programs)
+        print(f"[trace] {len(paths)} trace files under {trace_dir}; "
+              f"parsing the newest: {chosen}")
+    with gzip.open(chosen, "rt") as f:
         trace = json.load(f)
     events = trace.get("traceEvents", [])
     # chrome-trace metadata: pid -> process name, (pid, tid) -> thread
@@ -129,12 +137,16 @@ def parse(trace_dir: str, top: int, rounds: int):
               f"Processes seen: {sorted(set(pnames.values()))}")
         return None
     # a device process exports several stacked lanes (e.g. an 'XLA Modules'
-    # envelope spanning the whole executable above per-op 'XLA Ops' rows);
-    # summing across all of them double-counts. Keep only the op-level
-    # lane(s) when identifiable.
-    op_tids = {(p, t) for (p, t), n in tnames.items()
-               if p in dev_pids and "op" in n.lower()
-               and "module" not in n.lower()}
+    # envelope spanning the whole executable above per-op 'XLA Ops' rows,
+    # and often a 'TensorFlow Ops' framework-attribution lane covering the
+    # SAME device time); summing across all of them double-counts. Prefer
+    # the exact 'XLA Ops' lane(s); fall back to the substring heuristic
+    # only when no lane carries that name.
+    xla_tids = {(p, t) for (p, t), n in tnames.items()
+                if p in dev_pids and n.strip().lower() == "xla ops"}
+    op_tids = xla_tids or {(p, t) for (p, t), n in tnames.items()
+                           if p in dev_pids and "op" in n.lower()
+                           and "module" not in n.lower()}
 
     def in_op_lane(e):
         if (e["pid"], e.get("tid")) in op_tids:

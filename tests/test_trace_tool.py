@@ -71,6 +71,29 @@ def test_parse_counts_only_device_op_lane(tmp_path, capsys):
     assert groups == {"fusion": 1.5, "convolution": 2.5}
 
 
+def test_parse_prefers_xla_ops_over_framework_op_lane(tmp_path, capsys):
+    """Real TPU traces carry a 'TensorFlow Ops' framework-attribution lane
+    covering the SAME device time as 'XLA Ops'; counting both doubles every
+    number. When an exact 'XLA Ops' lane exists it must be the only lane
+    summed (r5 hardening for the first real-trace parse)."""
+    events = (
+        _meta(1, "/device:TPU:0", {10: "XLA Modules", 11: "XLA Ops",
+                                   12: "TensorFlow Ops"})
+        + [
+            {"ph": "X", "pid": 1, "tid": 11, "name": "fusion.1",
+             "dur": 1000.0},
+            # same time re-attributed on the framework lane: NOT counted
+            {"ph": "X", "pid": 1, "tid": 12, "name": "Conv2D",
+             "dur": 1000.0},
+        ])
+    tdir = _write_trace(tmp_path, events)
+    with open(tdir / "capture_meta.json", "w") as f:
+        json.dump({"rounds": 1}, f)
+    out = parse(str(tdir), top=5, rounds=1)
+    assert out["total_ms"] == 1.0             # XLA Ops lane only
+    assert {r["op"] for r in out["top_groups"]} == {"fusion"}
+
+
 def test_parse_reports_missing_device_lanes(tmp_path, capsys):
     events = _meta(2, "python host", {20: "main"}) + [
         {"ph": "X", "pid": 2, "tid": 20, "name": "dispatch", "dur": 5.0}]
