@@ -133,6 +133,12 @@ def main():
                          "default)")
     ap.add_argument("--clipnoise_noise", type=float, default=CLIPNOISE_NOISE,
                     help="noise multiplier for the clip+noise row")
+    ap.add_argument("--print_configs", action="store_true",
+                    help="dump the resolved config list (name + the "
+                         "calibration-bearing fields) as JSON and exit "
+                         "without touching any backend — lets tests pin "
+                         "row staging (chain overrides, bf16 row, seed "
+                         "variants) without running anything")
     ap.add_argument("--seeds", default="",
                     help="comma-separated extra seeds (e.g. 1,2): adds "
                          "seed-suffixed variants (name@sN) of the cheap "
@@ -404,6 +410,16 @@ def main():
         if not configs:
             sys.exit(f"--only {args.only!r} matches no config "
                      f"(note: --quick builds only the fmnist triple)")
+    if args.print_configs:
+        # after the --only filter so the preview shows exactly what a real
+        # run with the same flags would execute
+        fields = ("chain", "dtype", "seed", "aggr", "data_dir", "server_lr",
+                  "noise", "clip", "rounds", "synth_hardness", "remat",
+                  "agent_chunk", "robustLR_threshold")
+        print(json.dumps([
+            {"name": n, **{k: getattr(c, k) for k in fields}}
+            for n, c in configs]))
+        return
     order = ["fmnist-clean", "fmnist-attack", "fmnist-attack-rlr",
              "fmnist-attack-copyright", "fmnist-attack-copyright-rlr",
              "fmnist-attack-square", "fmnist-attack-square-rlr",

@@ -56,6 +56,34 @@ def test_regen_renders_seed_table_and_filters_seed_rows(tmp_path):
     assert "[0, 1, 2]" in text
 
 
+def test_print_configs_pins_row_staging(tmp_path):
+    """The close-out sweep's staged rows carry load-bearing calibrations
+    that nothing else checks until TPU time is burned: the clipnoise row
+    must dispatch per-round (chain=1 — the chain=10 clip+noise compile is
+    the program that wedged the r4 tunnel), the bf16 ResNet-9 row must
+    exist, the cifar DBA pair must join the seed matrix, and the sign rows
+    must pick up the per-rule hardness overrides."""
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(SCRIPT), "--print_configs",
+         "--seeds", "1,2", "--sign_data_dir", "./data_h025",
+         "--sign_hardness", "0.25"],
+        cwd=tmp_path, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    rows = {row["name"]: row for row in json.loads(r.stdout)}
+
+    assert rows["fmnist-attack-rlr-clipnoise"]["chain"] == 1
+    assert rows["fmnist-attack-rlr"]["chain"] == 10      # others unchanged
+    assert rows["cifar10-resnet9-dba-rlr-bf16"]["dtype"] == "bf16"
+    assert rows["cifar10-resnet9-dba-rlr-bf16"]["remat"]
+    for s in (1, 2):
+        assert f"cifar10-dba-rlr@s{s}" in rows
+        assert rows[f"cifar10-dba-rlr@s{s}"]["seed"] == s
+    sign = rows["fmnist-attack-sign"]
+    assert sign["data_dir"] == "./data_h025"
+    assert sign["synth_hardness"] == 0.25
+    assert sign["aggr"] == "sign"
+
+
 def test_regen_without_seed_rows_has_no_seed_section(tmp_path):
     with open(tmp_path / "results.json", "w") as f:
         json.dump([_row("fmnist-clean", 0.9, None)], f)
