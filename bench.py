@@ -40,8 +40,12 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+PROBE_CODE = "import jax; print('BACKEND=' + jax.default_backend())"
+
+
 def probe_backend(timeout_s: float, retries: int = 3,
-                  retry_wait_s: float = 45.0) -> str | None:
+                  retry_wait_s: float = 45.0,
+                  code: str = PROBE_CODE) -> str | None:
     """Return the default backend name, probed in a bounded subprocess.
 
     None means the backend never came up within the budget (wedged tunnel /
@@ -49,8 +53,8 @@ def probe_backend(timeout_s: float, retries: int = 3,
     compilation, so killing it cannot wedge a healthy chip mid-compile.
     A wedge can clear between attempts, so a failed probe is retried a few
     times (total worst case: retries * (timeout_s + retry_wait_s), still
-    bounded) before giving up."""
-    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    bounded) before giving up. `code` is injectable so tests can drive the
+    subprocess/timeout/retry machinery without a jax backend."""
     for attempt in range(retries):
         timed_out = False
         try:

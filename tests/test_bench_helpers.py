@@ -1,0 +1,55 @@
+"""bench.py helper tests — the pieces the driver's round-end artifact
+depends on, none of which need a backend.
+
+probe_backend decides whether BENCH_r{N}.json carries a TPU row or the
+CPU fallback: its subprocess/timeout/retry machinery is driven here with
+injected probe code (success / deterministic failure / hang), so a logic
+regression can't silently turn a healthy chip into a "wedged" fallback
+artifact (or hang the driver unboundedly on a real wedge).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import peak_tflops, probe_backend  # noqa: E402
+
+
+def test_probe_success_returns_backend_name():
+    out = probe_backend(timeout_s=30, retries=1,
+                        code="print('BACKEND=cpu')")
+    assert out == "cpu"
+
+
+def test_probe_deterministic_failure_returns_none_without_waiting():
+    import time
+    t0 = time.perf_counter()
+    out = probe_backend(timeout_s=30, retries=3, retry_wait_s=60.0,
+                        code="import sys; sys.exit(3)")
+    wall = time.perf_counter() - t0
+    assert out is None
+    # rc!=0 is not a hang: the retry loop must not sleep retry_wait_s
+    # between attempts (3 * 60s would stall the driver for minutes)
+    assert wall < 30
+
+
+def test_probe_hang_times_out_and_returns_none():
+    out = probe_backend(timeout_s=2, retries=2, retry_wait_s=0.1,
+                        code="import time; time.sleep(60)")
+    assert out is None
+
+
+def test_probe_ignores_noise_lines_around_backend_marker():
+    out = probe_backend(
+        timeout_s=30, retries=1,
+        code="print('WARNING: axon is experimental'); print('BACKEND=tpu')")
+    assert out == "tpu"
+
+
+def test_peak_tflops_table_order_and_unknowns():
+    assert peak_tflops("TPU v5 lite") == 197.0
+    # v5p must match before the v5 substring does
+    assert peak_tflops("TPU v5p") == 459.0
+    assert peak_tflops("TPU v6e") == 918.0
+    assert peak_tflops("TFRT_CPU_0") is None
