@@ -62,4 +62,25 @@ done
 say "step 4/4: figures refresh"
 python scripts/plot_curves.py >>"$LOG" 2>&1 || say "WARN: plot failed"
 
+# bank the measurement artifacts in git immediately: the session may fire
+# late in the round (the watcher waits out multi-hour wedges), and results
+# must survive even if the round ends minutes after recovery
+# git add/commit are all-or-nothing on unmatched pathspecs, and a failed
+# bench step legitimately leaves BENCH_TPU_r05.json absent — so build the
+# pathspec from the files that actually exist, and scope both the check
+# and the commit to them (unrelated pre-staged work in this checkout is
+# neither swept in nor sole trigger)
+PRESENT=""
+for f in BENCH_TPU_r05.json results.json RESULTS.md performance.png \
+         poison_acc.png BENCH_NOTES.md; do
+    [ -e "$f" ] && git add -- "$f" 2>>"$LOG" && PRESENT="$PRESENT $f"
+done
+if [ -z "$PRESENT" ] || git diff --cached --quiet -- $PRESENT; then
+    say "NOTE: no new artifacts to commit"
+elif git commit -m "TPU session results: bench, close-out sweep rows, seed matrix, figures" -- $PRESENT >>"$LOG" 2>&1; then
+    say "artifacts committed"
+else
+    say "WARN: artifact commit failed"
+fi
+
 say "r5 session complete — review BENCH_TPU_r05.json, results.json, RESULTS.md, $LOG"
