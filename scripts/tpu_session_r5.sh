@@ -12,13 +12,24 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=logs/tpu_session_r5.log
 mkdir -p logs
+# single-instance lock: overlapping watchers may both see the tunnel come
+# alive in the same window; a second concurrent session would race the
+# first for the one chip and interleave results.json writes. mkdir is
+# atomic; the lock is left in place on completion by design — this
+# session's obligations are once-per-round (rerun manually after
+# `rmdir logs/tpu_session_r5.lock` if a partial run needs finishing).
+if ! mkdir logs/tpu_session_r5.lock 2>/dev/null; then
+    echo "[session] another tpu_session_r5 instance holds the lock — exiting"
+    exit 0
+fi
 stamp() { date "+%F %T"; }
 say() { echo "[$(stamp)] $*" | tee -a "$LOG"; }
 
 say "probing TPU backend (60s budget)..."
 if ! timeout 60 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1; then
     say "TPU unreachable — aborting (wedged tunnel); re-run later"
-    exit 1
+    rmdir logs/tpu_session_r5.lock   # a no-measurement abort must not
+    exit 1                           # block the next (real) fire
 fi
 say "TPU alive"
 
