@@ -157,6 +157,10 @@ def main():
                     help="PRNG bit generator (auto = hardware rbg on TPU)")
     ap.add_argument("--use_pallas", action="store_true",
                     help="fused Pallas RLR+FedAvg server step")
+    ap.add_argument("--faults", action="store_true",
+                    help="also measure rounds/sec at 30%% client dropout "
+                         "(faults/ masking path) and report the masking "
+                         "overhead vs the dense 0%% run")
     ap.add_argument("--remat_policy", choices=("block", "conv", "none"),
                     default="block",
                     help="resnet9 config only: block = full blockwise "
@@ -250,35 +254,71 @@ def main():
     fed = get_federated_data(cfg)
     model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat,
                       remat_policy=cfg.remat_policy)
-    params = init_params(model, fed.train.images.shape[2:],
-                         jax.random.PRNGKey(0))
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
-    # chained execution: blocks of rounds fused into one lax.scan dispatch
-    # (bit-identical to per-round dispatch; see fl/rounds.py)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
     chain = args.chain
-    chained = make_chained_round_fn(cfg, model, norm,
-                                    jnp.asarray(fed.train.images),
-                                    jnp.asarray(fed.train.labels),
-                                    jnp.asarray(fed.train.sizes))
 
-    base_key = jax.random.PRNGKey(0)
-    # warmup / compile
-    t0 = time.perf_counter()
-    params, _ = chained(params, base_key, jnp.arange(1, chain + 1))
-    jax.block_until_ready(params)
-    compile_s = time.perf_counter() - t0
-    log(f"[bench] compile+first {chain}-round block: {compile_s:.1f}s")
+    def measure(mcfg, label=""):
+        """Compile + steady-state rounds/sec of mcfg's chained round fn.
 
-    n_rounds = args.blocks * chain
-    t0 = time.perf_counter()
-    for b in range(args.blocks):
-        ids = jnp.arange((b + 1) * chain + 1, (b + 2) * chain + 1)
-        params, _ = chained(params, base_key, ids)
-    jax.block_until_ready(params)
-    elapsed = time.perf_counter() - t0
-    rounds_per_sec = n_rounds / elapsed
-    log(f"[bench] {n_rounds} rounds in {elapsed:.2f}s "
-        f"-> {rounds_per_sec:.3f} rounds/sec steady-state")
+        Fresh params per call: the chained fn donates its params argument,
+        so a prior measurement's buffer cannot be reused."""
+        params = init_params(model, fed.train.images.shape[2:],
+                             jax.random.PRNGKey(0))
+        # chained execution: blocks of rounds fused into one lax.scan
+        # dispatch (bit-identical to per-round dispatch; see fl/rounds.py)
+        chained = make_chained_round_fn(mcfg, model, norm, *arrays)
+        base_key = jax.random.PRNGKey(0)
+        # warmup / compile
+        t0 = time.perf_counter()
+        params, _ = chained(params, base_key, jnp.arange(1, chain + 1))
+        jax.block_until_ready(params)
+        compile_s = time.perf_counter() - t0
+        log(f"[bench]{label} compile+first {chain}-round block: "
+            f"{compile_s:.1f}s")
+
+        n_rounds = args.blocks * chain
+        t0 = time.perf_counter()
+        for b in range(args.blocks):
+            ids = jnp.arange((b + 1) * chain + 1, (b + 2) * chain + 1)
+            params, _ = chained(params, base_key, ids)
+        jax.block_until_ready(params)
+        elapsed = time.perf_counter() - t0
+        rounds_per_sec = n_rounds / elapsed
+        log(f"[bench]{label} {n_rounds} rounds in {elapsed:.2f}s "
+            f"-> {rounds_per_sec:.3f} rounds/sec steady-state")
+        return params, rounds_per_sec, compile_s
+
+    params, rounds_per_sec, compile_s = measure(cfg)
+
+    faults_out = None
+    if args.faults:
+        # masking-overhead probe (faults/): the same config with 30% client
+        # dropout exercises the participation-mask aggregation path; the
+        # delta vs the dense 0% run is the cost of mask-aware aggregation
+        # (dropped agents still train — shapes are static — so compute
+        # doesn't shrink with the electorate)
+        r0 = rounds_per_sec
+        if cfg.use_pallas:
+            # the faults path can't take the fused Pallas server step, so a
+            # pallas-on 0% baseline would fold the kernel's win into
+            # "masking overhead" — re-measure the baseline unfused
+            log("[bench] --faults: re-measuring the 0% baseline without "
+                "the Pallas kernel for a like-for-like overhead figure")
+            _, r0, _ = measure(cfg.replace(use_pallas=False),
+                               label="[faults dropout=0, no pallas]")
+        _, r30, c30 = measure(
+            cfg.replace(dropout_rate=0.3, use_pallas=False),
+            label="[faults dropout=0.3]")
+        faults_out = {
+            "dropout0_rounds_per_sec": round(r0, 4),
+            "dropout30_rounds_per_sec": round(r30, 4),
+            "masking_overhead_pct": round(100.0 * (1.0 - r30 / r0), 2),
+            "dropout30_compile_s": round(c30, 1),
+        }
+        log(f"[bench] masking overhead at 30% dropout: "
+            f"{faults_out['masking_overhead_pct']}%")
 
     # performance anatomy (VERDICT r2 weak #1): FLOPs/round from XLA's own
     # cost analysis of the compiled client step, and MFU against the chip's
@@ -342,6 +382,8 @@ def main():
         out["tflops_per_sec"] = round(tflops_sec, 2)
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    if faults_out is not None:
+        out["faults"] = faults_out
     if cpu_fallback:
         # rounds are 10x smaller than the TPU config: value is NOT
         # comparable to TPU rows, vs_baseline (per-batch-normalized) is

@@ -26,6 +26,8 @@ Package layout::
     models/     Flax CNN_MNIST / CNN_CIFAR / ResNet-9
     ops/        numeric building blocks (sgd, clipping, aggregation rules, pallas)
     fl/         client local training, server aggregation, round step, eval
+    faults/     fault injection: dropout/straggler/corrupt-payload sampling
+                + the participation-mask aggregation protocol
     parallel/   mesh construction + shard_map round step
     utils/      metrics writers, checkpointing, misc
 """

@@ -95,6 +95,20 @@ class Config:
                                     # conv: save the conv (MXU) outputs and
                                     # recompute only the elementwise tail
                                     # (~3x saved bytes, no conv recompute)
+    # --- fault injection & elastic participation (faults/) ---
+    dropout_rate: float = 0.0       # per-round Bernoulli client dropout
+    straggler_rate: float = 0.0     # per-round straggler probability
+    straggler_epochs: int = 1       # local epochs a straggler completes
+    corrupt_rate: float = 0.0       # per-round corrupt-payload probability
+    corrupt_mode: str = "nan"       # nan | huge (1e30 finite constant)
+    payload_norm_cap: float = 0.0   # >0: server rejects updates with L2
+                                    # norm above the cap (validation mask)
+    faults_spare_corrupt: bool = False  # attackers never drop out (the
+                                    # adversarial participation model)
+    rlr_threshold_mode: str = "abs"  # abs: paper's absolute vote count;
+                                    # scaled: threshold * n_eff / m keeps
+                                    # the required agreement fraction
+                                    # invariant under churn
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -113,6 +127,15 @@ class Config:
                                     # raises pixel noise and adds label noise
                                     # so val_acc climbs over tens of rounds
                                     # instead of saturating immediately
+
+    @property
+    def faults_enabled(self) -> bool:
+        """Any nonzero fault rate — or a payload-norm cap, which needs the
+        server-side validation + participation mask to act — routes the
+        round through the faults path (faults/); all-off keeps the dense
+        path bit-for-bit."""
+        return (self.dropout_rate > 0 or self.straggler_rate > 0
+                or self.corrupt_rate > 0 or self.payload_norm_cap > 0)
 
     @property
     def effective_server_lr(self) -> float:
@@ -249,6 +272,37 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    help="remat flavor: block = recompute everything; conv "
                         "= save conv (MXU) outputs, recompute only the "
                         "elementwise tail")
+    p.add_argument("--dropout_rate", type=float, default=d.dropout_rate,
+                   help="per-round Bernoulli client dropout probability "
+                        "(faults/: dropped agents are masked out of "
+                        "aggregation; at least one agent always survives)")
+    p.add_argument("--straggler_rate", type=float, default=d.straggler_rate,
+                   help="per-round straggler probability; a straggler's "
+                        "local training truncates to --straggler_epochs")
+    p.add_argument("--straggler_epochs", type=int, default=d.straggler_epochs,
+                   help="local epochs a straggler completes (capped at "
+                        "--local_ep)")
+    p.add_argument("--corrupt_rate", type=float, default=d.corrupt_rate,
+                   help="per-round corrupt-payload probability; garbage "
+                        "updates are caught by server-side payload "
+                        "validation and masked out")
+    p.add_argument("--corrupt_mode", choices=("nan", "huge"),
+                   default=d.corrupt_mode,
+                   help="corrupt-payload flavor: nan (caught by the finite "
+                        "check) or huge (1e30 finite — needs "
+                        "--payload_norm_cap or a robust aggregator)")
+    p.add_argument("--payload_norm_cap", type=float,
+                   default=d.payload_norm_cap,
+                   help=">0: server rejects updates whose L2 norm exceeds "
+                        "the cap (joins the participation mask)")
+    p.add_argument("--faults_spare_corrupt", action="store_true",
+                   help="malicious agents (id < num_corrupt) never drop "
+                        "out: the adversarial participation model that "
+                        "thins the RLR defense's honest majority")
+    p.add_argument("--rlr_threshold_mode", choices=("abs", "scaled"),
+                   default=d.rlr_threshold_mode,
+                   help="RLR vote threshold under faults: abs = paper's "
+                        "absolute count; scaled = threshold * n_eff / m")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--log_dir", type=str, default=d.log_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)

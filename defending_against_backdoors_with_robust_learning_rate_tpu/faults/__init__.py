@@ -1,0 +1,25 @@
+"""Fault injection & elastic participation.
+
+The reference simulator (and the seed of this repo) assumes every sampled
+agent returns a complete, on-time, well-formed update every round. At
+production scale that is the exception: clients drop out mid-round, straggle
+(return after training fewer local epochs), or return corrupt payloads.
+This package makes those failure modes first-class *inside the jitted
+round* — fault draws are seeded per-round functions of the round key, all
+shapes stay static, and one compiled program serves every round regardless
+of which agents fail:
+
+    model.py    seeded per-round fault sampling (Bernoulli dropout,
+                straggler epoch truncation, corrupt-payload injection) and
+                server-side payload validation
+    masking.py  the participation-mask protocol: masked weighted sums,
+                masked sign votes with a mask-aware RLR threshold, masked
+                median/sort via +inf sentinel padding — every aggregation
+                rule operates on a fixed [m]-shaped mask
+
+Dropout changes the effective voter count of the paper's RLR
+sign-agreement defense, so this subsystem opens the experiment axis the
+seed could not study: how robust is the defense when the honest-voter
+majority is thinned by churn while attackers never drop out
+(``--faults_spare_corrupt``)?
+"""

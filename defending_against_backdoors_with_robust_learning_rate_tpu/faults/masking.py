@@ -1,0 +1,237 @@
+"""The participation-mask protocol: aggregation over a fixed [m]-shaped mask.
+
+Every aggregation rule in `ops/aggregate.py` (and its collective twin in
+`parallel/rounds.py`) can run over a traced boolean mask marking which of
+the m sampled agents actually delivered a usable update this round. Masked
+agents are excluded *arithmetically*, never by shrinking arrays, so shapes
+stay static and one compiled round program serves every fault draw:
+
+- sum-based rules (avg, sign, RLR vote, RFA weights): non-participant rows
+  and their weights are zeroed (`jnp.where` on the row, which also
+  sanitizes NaN/garbage payloads — a multiply by 0 would propagate NaN);
+- sort-based rules (comed, trmean): non-participant rows become +inf
+  sentinels that sort to the end; the median/trim indices are traced
+  functions of the effective count;
+- krum: non-participant rows/columns of the pairwise-distance matrix are
+  +inf, the neighbour count k follows the effective count, and masked
+  candidates can never win the argmin.
+
+Bit-parity contract (tests/test_faults.py): with an all-ones mask every
+helper is bit-identical to the dense path in ops/aggregate.py, because each
+masked formulation degenerates to the same op sequence: `where(True, x, s)
+== x` bitwise; every reduction keeps the dense path's SHAPE (full-[m] sums
+where the dense rule sums all rows, traced-start/static-size dynamic-slice
+windows where the dense rule sums a slice — reduction shape determines
+XLA's add association, so a shape mismatch drifts by an ulp); and traced
+counts divide via reciprocal-multiply exactly like XLA's strength-reduced
+divide-by-constant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
+    RFA_EPS, RFA_ITERS, agent_sq_dists, sq_dist_accum)
+
+
+def _bcast(mask, u):
+    """[m] mask broadcast against an [m, ...] row-stacked array."""
+    return mask.reshape((-1,) + (1,) * (u.ndim - 1))
+
+
+def count(mask):
+    """Effective participant count as int32 (traced)."""
+    return jnp.sum(mask.astype(jnp.int32))
+
+
+def count_f32(mask):
+    return jnp.sum(mask.astype(jnp.float32))
+
+
+def zero_rows(u, mask):
+    """Rows of non-participants replaced by exact zeros. `where` (not a
+    multiply) so NaN/inf garbage in masked rows cannot propagate."""
+    return jnp.where(_bcast(mask, u), u, jnp.zeros((), u.dtype))
+
+
+def zero_masked(stacked_updates, mask):
+    """`zero_rows` over every leaf of a stacked update pytree."""
+    return tree.map(lambda u: zero_rows(u, mask), stacked_updates)
+
+
+def guard_empty(agg_tree, mask):
+    """All-invalid round: every sampled agent dropped or failed payload
+    validation (the dropout sampler guarantees one *survivor*, but its
+    payload can still be rejected). The aggregate is then undefined (0/0
+    weighted sums, 1/0 Weiszfeld scales, sentinel medians) — replace it
+    with zeros so the round is a parameter-preserving no-op instead of NaN
+    poisoning every subsequent round. Faults/Effective_Voters logs 0 for
+    the round, so the event is observable."""
+    any_valid = jnp.any(mask)
+    return tree.map(lambda a: jnp.where(any_valid, a, jnp.zeros_like(a)),
+                    agg_tree)
+
+
+def rlr_threshold(cfg, mask):
+    """Mask-aware RLR vote threshold. ``abs`` keeps the paper's absolute
+    count (the vote just loses the masked voters); ``scaled`` shrinks the
+    threshold with the effective electorate (threshold * n_eff / m) so the
+    required agreement *fraction* is invariant under churn."""
+    thr = float(cfg.robustLR_threshold)
+    if cfg.rlr_threshold_mode == "scaled":
+        return thr * count_f32(mask) / mask.shape[0]
+    return thr
+
+
+# ------------------------------------------------------------ array level ---
+
+def median_rows(u, mask, n_eff):
+    """Lower median over participant rows of [m, ...]: +inf sentinels sort
+    masked rows last; the torch-style lower-median index follows the traced
+    effective count."""
+    srt = jnp.sort(jnp.where(_bcast(mask, u), u, jnp.inf), axis=0)
+    return jnp.take(srt, (n_eff - 1) // 2, axis=0)
+
+
+def trimmed_mean_rows(u, mask, n_eff, trim_k):
+    """Coordinate-wise trimmed mean over participant rows of [m, ...]: sort
+    with +inf sentinels, then average the untrimmed band [k, n_eff - k).
+
+    Bit-parity construction: the band is read through a `dynamic_slice`
+    window of the DENSE band's static length (traced start k, so the
+    reduction has the exact shape of the dense slice sum — a full-[m]
+    masked sum would associate its adds differently and drift by an ulp),
+    with a within-window position mask zeroing the traced tail. The final
+    scale is a reciprocal-multiply, not a division: XLA strength-reduces
+    the dense path's divide-by-constant count to a multiply, so the
+    traced-count path must take the same rounding."""
+    m = u.shape[0]
+    srt = jnp.sort(jnp.where(_bcast(mask, u), u, jnp.inf), axis=0)
+    k_s = max(0, min(int(trim_k), (m - 1) // 2))   # dense static clamp
+    L = m - 2 * k_s                                # dense band length
+    k = jnp.clip(trim_k, 0, (n_eff - 1) // 2)      # traced effective trim
+    win = jax.lax.dynamic_slice_in_dim(srt, k, L, axis=0)
+    pos = jnp.arange(L).reshape((-1,) + (1,) * (u.ndim - 1))
+    # cnt can only exceed L in the pathological maximal-trim shapes
+    # (m <= 2*trim_k + 2); clamp so the mean stays a mean
+    cnt = jnp.minimum(n_eff - 2 * k, L)
+    band = pos < cnt
+    return (jnp.sum(jnp.where(band, win, jnp.zeros((), win.dtype)), axis=0)
+            * (1.0 / cnt.astype(jnp.float32)))
+
+
+def krum_best(dist, mask, n_eff, num_corrupt):
+    """Masked Krum winner over a clamped [m, m] squared-distance matrix:
+    rows/columns of non-participants are +inf, the neighbour count follows
+    the effective electorate (clipped so selected positions only ever cover
+    finite distances), and masked candidates score +inf so the argmin is
+    always a participant."""
+    m = dist.shape[0]
+    pair = mask[:, None] & mask[None, :]
+    dist = jnp.where(pair, dist, jnp.inf)
+    srt = jnp.sort(dist, axis=1)
+    # dense k = max(m - f - 2, 1); masked follows n_eff, with the upper clip
+    # keeping selected positions inside the n_eff finite entries of a valid
+    # row (and k = 0 when a single survivor has no neighbours to score).
+    # The window over positions 1..L is the dense slice — static shape, so
+    # the score reduction associates exactly like the dense path's
+    # (trimmed_mean_rows explains the parity construction).
+    L = max(m - num_corrupt - 2, 1)
+    k = jnp.clip(n_eff - num_corrupt - 2,
+                 jnp.minimum(n_eff - 1, 1), jnp.maximum(n_eff - 1, 0))
+    win = srt[:, 1:L + 1]
+    sel = jnp.arange(L)[None, :] < k
+    scores = jnp.sum(jnp.where(sel, win, jnp.zeros((), win.dtype)), axis=1)
+    return jnp.argmin(jnp.where(mask, scores, jnp.inf))
+
+
+# ------------------------------------------------------------- tree level ---
+
+def masked_avg(stacked_updates, data_sizes, mask):
+    """Weighted FedAvg over participants (agg_avg semantics, masked)."""
+    w = jnp.where(mask, data_sizes.astype(jnp.float32), 0.0)
+    total = jnp.sum(w)
+    zeroed = zero_masked(stacked_updates, mask)
+
+    def leaf(u):
+        wshape = (-1,) + (1,) * (u.ndim - 1)
+        return jnp.sum(u * w.reshape(wshape), axis=0) / total
+    return tree.map(leaf, zeroed)
+
+
+def masked_sign(stacked_updates, mask):
+    """Majority-sign over participants: zeroed rows vote sign(0) = 0."""
+    zeroed = zero_masked(stacked_updates, mask)
+    return tree.map(lambda u: jnp.sign(jnp.sum(jnp.sign(u), axis=0)), zeroed)
+
+
+def masked_comed(stacked_updates, mask):
+    n_eff = count(mask)
+    return tree.map(lambda u: median_rows(u, mask, n_eff), stacked_updates)
+
+
+def masked_trmean(stacked_updates, mask, trim_k):
+    n_eff = count(mask)
+    return tree.map(lambda u: trimmed_mean_rows(u, mask, n_eff, trim_k),
+                    stacked_updates)
+
+
+def masked_krum(stacked_updates, mask, num_corrupt):
+    """Krum over participants. Distances accumulate over zeroed rows (so
+    garbage payloads cannot poison the matrix); masked candidates are
+    disqualified inside `krum_best`. The winner's update is read from the
+    zeroed stack — identical to its raw update for any participant."""
+    zeroed = zero_masked(stacked_updates, mask)
+    leaves = jax.tree_util.tree_leaves(zeroed)
+    m = leaves[0].shape[0]
+    d = jnp.zeros((m, m), jnp.float32)
+    for u in leaves:
+        d = sq_dist_accum(d, u.reshape(m, -1))
+    d = jnp.maximum(d, 0.0)
+    best = krum_best(d, mask, count(mask), num_corrupt)
+    return tree.map(lambda u: u[best], zeroed)
+
+
+def masked_rfa(stacked_updates, mask, iters: int = RFA_ITERS,
+               eps: float = RFA_EPS):
+    """Smoothed-Weiszfeld geometric median over participants (agg_rfa
+    semantics): the iterate starts from the participant mean and masked
+    agents carry weight 0 in every reweighting."""
+    zeroed = zero_masked(stacked_updates, mask)
+    n_eff = count_f32(mask)
+    mf = mask.astype(jnp.float32)
+    # reciprocal-multiply: the dense mean's divide-by-constant is
+    # strength-reduced by XLA (see trimmed_mean_rows)
+    v = tree.map(
+        lambda u: jnp.sum(u.astype(jnp.float32), axis=0) * (1.0 / n_eff),
+        zeroed)
+    for _ in range(iters):
+        w = mf / jnp.maximum(jnp.sqrt(agent_sq_dists(zeroed, v)), eps)
+        wsum = jnp.sum(w)
+
+        def leaf(u, w=w, wsum=wsum):
+            wshape = (-1,) + (1,) * (u.ndim - 1)
+            return jnp.sum(u * w.reshape(wshape), axis=0) / wsum
+        v = tree.map(leaf, zeroed)
+    return v
+
+
+def masked_aggregate(stacked_updates, data_sizes, cfg, mask):
+    """Mask-aware dispatch mirroring ops/aggregate.aggregate_updates (the
+    caller adds server noise; noise is mask-independent)."""
+    if cfg.aggr == "avg":
+        return masked_avg(stacked_updates, data_sizes, mask)
+    if cfg.aggr == "comed":
+        return masked_comed(stacked_updates, mask)
+    if cfg.aggr == "sign":
+        return masked_sign(stacked_updates, mask)
+    if cfg.aggr == "trmean":
+        return masked_trmean(stacked_updates, mask, cfg.num_corrupt)
+    if cfg.aggr == "krum":
+        return masked_krum(stacked_updates, mask, cfg.num_corrupt)
+    if cfg.aggr == "rfa":
+        return masked_rfa(stacked_updates, mask)
+    raise ValueError(f"unknown aggr {cfg.aggr!r}")

@@ -1,0 +1,132 @@
+"""Seeded per-round fault sampling + payload validation.
+
+Fault draws are pure functions of a key derived from the round key
+(`fault_key`), so they are reproducible under ``--seed``, identical across
+the single-device and sharded paths (every device of a mesh derives the
+same replicated [m] draw from the same replicated key — no collective
+needed to agree on who failed), and identical between per-round and
+chained dispatch. All outputs are fixed [m]-shaped arrays: varying fault
+draws across rounds reuse one compiled round program.
+
+Three failure modes (all off by default; any nonzero rate enables the
+faults path, `Config.faults_enabled`):
+
+- dropout (``--dropout_rate``): Bernoulli per sampled agent; a dropped
+  agent's update never reaches aggregation (participation mask). At least
+  one participant is always retained — a fully-empty round has no defined
+  aggregate.
+- stragglers (``--straggler_rate``/``--straggler_epochs``): a straggler's
+  local training is truncated to ``straggler_epochs`` epochs via the
+  batch-weight machinery of fl/client.py (epochs past the budget become
+  exact no-op steps); the partial update still participates.
+- corrupt payloads (``--corrupt_rate``/``--corrupt_mode``): the agent's
+  returned update is overwritten with garbage (NaN, or a huge finite
+  constant). Server-side `payload_valid` rejects non-finite payloads (and
+  optionally payloads over ``--payload_norm_cap``) before they can enter
+  the mask — under ``--debug_nan`` the injected NaNs instead trip the
+  checkify guards, which is the supported way to exercise them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
+
+# fold_in tag separating the fault stream from every other per-round stream
+# (the driver derives k_sample/k_train/k_noise by split; folding a constant
+# into k_noise leaves all existing streams untouched, so a zero-rate faults
+# config reproduces the dense path bit-for-bit)
+FAULTS_KEY_TAG = 0x5FA17
+
+
+class FaultDraw(NamedTuple):
+    participate: jax.Array   # [m] bool — survived dropout
+    straggler: jax.Array     # [m] bool — epoch-truncated this round
+    ep_budget: jax.Array     # [m] int32 — local epochs each agent completes
+    corrupt: jax.Array       # [m] bool — payload replaced with garbage
+
+
+def fault_key(k_noise):
+    """The round's fault stream, derived without consuming k_noise."""
+    return jax.random.fold_in(k_noise, FAULTS_KEY_TAG)
+
+
+def sample_faults(cfg, key, m: int, corrupt_flags=None) -> FaultDraw:
+    """One round's fault draw for the m sampled agents.
+
+    `corrupt_flags` ([m] bool, slot holds a malicious agent) feeds the
+    ``--faults_spare_corrupt`` adversarial participation model: attackers
+    never drop out while honest voters churn — the regime where the RLR
+    defense's effective majority is thinnest."""
+    k_drop, k_strag, k_corr = jax.random.split(key, 3)
+    u = jax.random.uniform(k_drop, (m,))
+    drop = u < cfg.dropout_rate
+    if cfg.faults_spare_corrupt and corrupt_flags is not None:
+        drop = drop & ~corrupt_flags
+    # never lose the whole round: if every agent dropped, retain the one
+    # whose draw was farthest from the dropout region
+    keep = jnp.argmax(u)
+    drop = jnp.where(jnp.all(drop) & (jnp.arange(m) == keep), False, drop)
+    straggler = jax.random.uniform(k_strag, (m,)) < cfg.straggler_rate
+    ep_budget = jnp.where(
+        straggler, min(cfg.straggler_epochs, cfg.local_ep),
+        cfg.local_ep).astype(jnp.int32)
+    corrupt = jax.random.uniform(k_corr, (m,)) < cfg.corrupt_rate
+    return FaultDraw(~drop, straggler, ep_budget, corrupt)
+
+
+# a large-but-finite f32 payload: slips past the finite check (that is the
+# point — it exercises the norm-cap / robust-aggregation layers instead)
+HUGE_PAYLOAD = 1e30
+
+
+def inject_corrupt(stacked_updates, corrupt, mode: str):
+    """Overwrite corrupt agents' rows with garbage. Deterministic constants
+    (NaN / ±HUGE via the row's update sign would add RNG for no modelling
+    value), so the vmap and shard_map paths agree bit-for-bit."""
+    if mode == "nan":
+        val = jnp.nan
+    elif mode == "huge":
+        val = HUGE_PAYLOAD
+    else:
+        raise ValueError(f"corrupt_mode must be nan|huge, got {mode!r}")
+
+    def leaf(u):
+        mask = corrupt.reshape((-1,) + (1,) * (u.ndim - 1))
+        return jnp.where(mask, jnp.full((), val, u.dtype), u)
+    return tree.map(leaf, stacked_updates)
+
+
+def payload_valid(stacked_updates, norm_cap: float = 0.0):
+    """[m] bool server-side payload validation: every coordinate finite,
+    and (when ``norm_cap`` > 0) global L2 norm under the cap. A huge-but-
+    finite payload overflows its squared norm to +inf, which the cap
+    comparison rejects as well."""
+    leaves = jax.tree_util.tree_leaves(stacked_updates)
+    m = leaves[0].shape[0]
+    valid = jnp.ones((m,), bool)
+    sumsq = jnp.zeros((m,), jnp.float32)
+    for u in leaves:
+        flat = u.reshape(m, -1)
+        valid = valid & jnp.isfinite(flat).all(axis=1)
+        if norm_cap > 0:
+            sumsq = sumsq + jnp.sum(
+                flat.astype(jnp.float32) * flat.astype(jnp.float32), axis=1)
+    if norm_cap > 0:
+        valid = valid & (sumsq <= jnp.float32(norm_cap) ** 2)
+    return valid
+
+
+def fault_scalars(draw: FaultDraw, mask):
+    """Degradation observability: the Faults/* scalar set the driver logs
+    (fault_dropped excludes payload-validation kills — those show up as the
+    gap between m - dropped and effective voters)."""
+    return {
+        "fault_dropped": jnp.sum((~draw.participate).astype(jnp.float32)),
+        "fault_straggled": jnp.sum(draw.straggler.astype(jnp.float32)),
+        "fault_voters": jnp.sum(mask.astype(jnp.float32)),
+    }

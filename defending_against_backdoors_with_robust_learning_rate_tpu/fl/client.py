@@ -59,7 +59,7 @@ def make_local_train(model, cfg, normalize):
               f"REMOVED — measurement mode, results are not real training",
               flush=True)
 
-    def local_train(params0, images, labels, size, key):
+    def _local_train(params0, images, labels, size, key, ep_budget):
         n_total = images.shape[0]
         nb = n_total // bs
         # policy for ops/loops.maybe_unrolled_scan (XLA:CPU conv-in-while
@@ -68,8 +68,16 @@ def make_local_train(model, cfg, normalize):
         py_loops = loops.cpu_backend() and cfg.local_ep * nb <= 16
         params0 = tree.astype(params0, jnp.float32)
 
-        def epoch_body(carry, ep_key):
+        def epoch_body(carry, xs):
+            ep_key, ep_idx = xs
             params, mom = carry
+            # straggler truncation (faults/): epochs past the agent's budget
+            # zero every batch weight, so the already-masked optimizer step
+            # (and the loss accumulation) become exact no-ops. When the
+            # budget is the static local_ep (no stragglers configured), XLA
+            # constant-folds ep_active=True away — the dense path's program
+            # is unchanged.
+            ep_active = ep_idx < ep_budget
             shuffle_key, drop_key = jax.random.split(ep_key)
             if "noshuffle" in ablate:
                 perm = jnp.arange(n_total)  # real samples already in front
@@ -90,7 +98,7 @@ def make_local_train(model, cfg, normalize):
                 else:
                     x = jnp.take(images, idx, axis=0)
                 y = jnp.take(labels, idx, axis=0)
-                w = (b * bs + jnp.arange(bs)) < size
+                w = ((b * bs + jnp.arange(bs)) < size) & ep_active
 
                 def loss_fn(p):
                     if "nodropout" in ablate:
@@ -120,9 +128,18 @@ def make_local_train(model, cfg, normalize):
 
         ep_keys = jax.random.split(key, cfg.local_ep)
         (params, _), ep_losses = loops.maybe_unrolled_scan(
-            epoch_body, (params0, tree.zeros_like(params0)), ep_keys,
-            py_loops)
+            epoch_body, (params0, tree.zeros_like(params0)),
+            (ep_keys, jnp.arange(cfg.local_ep)), py_loops)
         update = tree.sub(params, params0)
         return update, jnp.mean(ep_losses)
+
+    if cfg.straggler_rate > 0:
+        # faults path: callers pass a per-agent epoch budget (6th arg)
+        return _local_train
+
+    def local_train(params0, images, labels, size, key):
+        # dense path: the static full budget constant-folds to a no-op
+        return _local_train(params0, images, labels, size, key,
+                            jnp.int32(cfg.local_ep))
 
     return local_train

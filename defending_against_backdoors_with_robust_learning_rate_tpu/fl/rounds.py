@@ -31,18 +31,24 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops import loops
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     aggregate_updates, apply_aggregate, robust_lr)
 
+# fault observability scalars (faults/model.fault_scalars) that chained
+# blocks carry through their lax.scan alongside train_loss
+FAULT_INFO_KEYS = ("fault_dropped", "fault_straggled", "fault_voters")
+
 
 def _pallas_applicable(cfg) -> bool:
     """The fused Pallas server step covers the (weighted-FedAvg or signSGD
     [+ RLR], no server noise) paths — the paper's headline configurations.
     Diagnostics need the explicit lr tree, which the fused kernel never
-    materializes."""
+    materializes; the faults path needs the participation mask threaded
+    through the vote, which the fused kernel does not take."""
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
-            and cfg.noise == 0 and not cfg.diagnostics)
+            and cfg.noise == 0 and not cfg.diagnostics
+            and not cfg.faults_enabled)
 
 
 def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
-                chunk: int = 0):
+                chunk: int = 0, ep_budget=None):
     """vmap local training over the leading agents axis, optionally in
     sequential chunks of `chunk` agents (`lax.map` over chunk groups).
 
@@ -52,8 +58,13 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
     trades a factor m/c of round latency for a factor m/c of activation
     memory. Results are independent of the chunking (each agent's training
     is independent); chunk must divide the (per-device) agent count, else
-    the full vmap runs."""
-    vt = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))
+    the full vmap runs.
+
+    `ep_budget` ([m] int32, faults/) rides the same agents axis when the
+    straggler fault is configured — local_train then takes it as a sixth
+    per-agent argument."""
+    extra = () if ep_budget is None else (ep_budget,)
+    vt = jax.vmap(local_train, in_axes=(None,) + (0,) * (4 + len(extra)))
     m = imgs.shape[0]
     if 0 < chunk < m and m % chunk != 0:
         # falling back to the full vmap would reproduce the exact
@@ -62,7 +73,7 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
             f"--agent_chunk {chunk} does not divide the agent block of {m} "
             f"(per-device agent count); pick a divisor or 0 for full vmap")
     if chunk <= 0 or chunk >= m:
-        return vt(params, imgs, lbls, sizes, keys)
+        return vt(params, imgs, lbls, sizes, keys, *extra)
     nc = m // chunk
 
     def resh(a):
@@ -75,7 +86,7 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
     # while-loops via a slow reference path (ops/loops.py), so short chunk
     # loops are traced flat on the CPU backend
     _, (updates, losses) = loops.maybe_unrolled_scan(
-        body, 0, (resh(imgs), resh(lbls), resh(sizes), resh(keys)),
+        body, 0, tuple(resh(a) for a in (imgs, lbls, sizes, keys) + extra),
         loops.cpu_backend() and nc <= 16)
     return (jax.tree_util.tree_map(
         lambda u: u.reshape((m,) + u.shape[2:]), updates),
@@ -83,13 +94,41 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
 
 
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
-                local_train, cfg):
-    """Shared round body: vmapped local training + aggregation + update."""
+                local_train, cfg, corrupt_flags=None):
+    """Shared round body: vmapped local training + aggregation + update.
+
+    With faults configured (cfg.faults_enabled) the round additionally
+    draws the per-agent fault pattern from the round key (faults/model.py),
+    truncates stragglers' epochs, injects corrupt payloads, validates
+    payloads server-side, and aggregates over the resulting participation
+    mask (faults/masking.py). `corrupt_flags` marks which sampled slots
+    hold malicious agents (for --faults_spare_corrupt)."""
     m = imgs.shape[0]
     agent_keys = jax.random.split(k_train, m)
+    draw = None
+    ep_budget = None
+    if cfg.faults_enabled:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            model as fmodel)
+        draw = fmodel.sample_faults(cfg, fmodel.fault_key(k_noise), m,
+                                    corrupt_flags)
+        if cfg.straggler_rate > 0:
+            ep_budget = draw.ep_budget
     updates, losses = vmap_agents(local_train, params, imgs, lbls, sizes,
-                                  agent_keys, cfg.agent_chunk)
-    if _pallas_applicable(cfg):
+                                  agent_keys, cfg.agent_chunk,
+                                  ep_budget=ep_budget)
+    mask = None
+    extras = {}
+    if draw is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking, model as fmodel)
+        if cfg.corrupt_rate > 0:
+            updates = fmodel.inject_corrupt(updates, draw.corrupt,
+                                            cfg.corrupt_mode)
+        mask = draw.participate & fmodel.payload_valid(
+            updates, cfg.payload_norm_cap)
+        extras = fmodel.fault_scalars(draw, mask)
+    if _pallas_applicable(cfg):   # never taken when faults are configured
         from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
             fused_rlr_avg_apply)
         new_params = fused_rlr_avg_apply(
@@ -98,13 +137,16 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
             interpret=jax.default_backend() != "tpu", mode=cfg.aggr)
         return new_params, jnp.mean(losses), {}
     if cfg.robustLR_threshold > 0:
-        lr = robust_lr(updates, float(cfg.robustLR_threshold),
-                       cfg.effective_server_lr)
+        thr = (masking.rlr_threshold(cfg, mask) if mask is not None
+               else float(cfg.robustLR_threshold))
+        lr = robust_lr(updates, thr, cfg.effective_server_lr, mask=mask)
     else:
         lr = cfg.effective_server_lr
-    agg = aggregate_updates(updates, sizes, cfg, k_noise)
+    agg = aggregate_updates(updates, sizes, cfg, k_noise, mask=mask)
+    if mask is not None:
+        # all payloads dropped/rejected -> zero aggregate, no-op round
+        agg = masking.guard_empty(agg, mask)
     new_params = apply_aggregate(params, lr, agg)
-    extras = {}
     if cfg.diagnostics:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
             per_agent_norms)
@@ -133,8 +175,10 @@ def make_chained(step, data):
         def body(params, rnd):
             new_params, info = step(params, jax.random.fold_in(base_key, rnd),
                                     *data_args)
-            return new_params, {"train_loss": info["train_loss"],
-                                "sampled": info["sampled"]}
+            out = {"train_loss": info["train_loss"],
+                   "sampled": info["sampled"]}
+            out.update({k: info[k] for k in FAULT_INFO_KEYS if k in info})
+            return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short
         # chains; each chain step is a whole round so the cap stays small
@@ -173,7 +217,9 @@ def _make_sample_step(cfg, model, normalize):
         szs = jnp.take(sizes, sampled, axis=0)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
-            local_train=local_train, cfg=cfg)
+            local_train=local_train, cfg=cfg,
+            corrupt_flags=(sampled < cfg.num_corrupt
+                           if cfg.faults_enabled else None))
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
@@ -220,8 +266,22 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
 def make_host_step(cfg, model, normalize):
     """Unjitted host-sampled step(params, key, imgs, lbls, sizes) — the
     shared body of the per-round and chained host fns (key split into
-    k_train/k_noise matches bit-for-bit between them)."""
+    k_train/k_noise matches bit-for-bit between them).
+
+    With faults configured the step takes a sixth argument: the [m] bool
+    `corrupt_flags` for the sampled slots (the driver computes it from the
+    host-sampled ids — in-jit sampling isn't available to derive it here)."""
     local_train = make_local_train(model, cfg, normalize)
+
+    if cfg.faults_enabled:
+        def step(params, key, imgs, lbls, sizes, corrupt_flags):
+            k_train, k_noise = jax.random.split(key)
+            new_params, train_loss, extras = _round_core(
+                params, k_train, k_noise, imgs, lbls, sizes,
+                local_train=local_train, cfg=cfg,
+                corrupt_flags=corrupt_flags)
+            return new_params, {"train_loss": train_loss, **extras}
+        return step
 
     def step(params, key, imgs, lbls, sizes):
         k_train, k_noise = jax.random.split(key)
@@ -259,7 +319,9 @@ def make_chained_host(step):
             rnd, im, lb, sz = xs
             new_params, info = step(
                 params, jax.random.fold_in(base_key, rnd), im, lb, sz)
-            return new_params, {"train_loss": info["train_loss"]}
+            out = {"train_loss": info["train_loss"]}
+            out.update({k: info[k] for k in FAULT_INFO_KEYS if k in info})
+            return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short chains
         py_loops = loops.cpu_backend() and round_ids.shape[0] <= 16

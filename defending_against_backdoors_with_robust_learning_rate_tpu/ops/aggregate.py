@@ -37,17 +37,30 @@ import jax.numpy as jnp
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 
 
-def robust_lr(stacked_updates, threshold: float, server_lr: float):
+def robust_lr(stacked_updates, threshold, server_lr: float, mask=None):
     """Per-parameter learning-rate tree: +server_lr where the sign-agreement
-    vote reaches `threshold`, else -server_lr (src/aggregation.py:48-54)."""
+    vote reaches `threshold`, else -server_lr (src/aggregation.py:48-54).
+
+    With a participation `mask` ([m] bool, faults/masking.py) only masked-in
+    agents vote (their rows are zeroed, contributing sign 0); `threshold`
+    may then be a traced scalar (the mask-aware scaled threshold)."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        stacked_updates = masking.zero_masked(stacked_updates, mask)
+
     def leaf(u):
         s = jnp.abs(jnp.sum(jnp.sign(u), axis=0))
         return jnp.where(s >= threshold, server_lr, -server_lr).astype(jnp.float32)
     return tree.map(leaf, stacked_updates)
 
 
-def agg_avg(stacked_updates, data_sizes):
+def agg_avg(stacked_updates, data_sizes, mask=None):
     """Weighted FedAvg: sum_k n_k u_k / sum_k n_k (src/aggregation.py:57-64)."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        return masking.masked_avg(stacked_updates, data_sizes, mask)
     w = data_sizes.astype(jnp.float32)
     total = jnp.sum(w)
 
@@ -57,11 +70,15 @@ def agg_avg(stacked_updates, data_sizes):
     return tree.map(leaf, stacked_updates)
 
 
-def agg_comed(stacked_updates):
+def agg_comed(stacked_updates, mask=None):
     """Per-coordinate median over the agent axis (src/aggregation.py:66-69).
 
     With an even agent count this matches torch.median (lower of the two
     middle values), NOT numpy's midpoint interpolation."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        return masking.masked_comed(stacked_updates, mask)
     m = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
 
     def leaf(u):
@@ -70,8 +87,12 @@ def agg_comed(stacked_updates):
     return tree.map(leaf, stacked_updates)
 
 
-def agg_sign(stacked_updates):
+def agg_sign(stacked_updates, mask=None):
     """Majority-sign update: sign(sum_k sign(u_k)) (src/aggregation.py:71-75)."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        return masking.masked_sign(stacked_updates, mask)
     return tree.map(lambda u: jnp.sign(jnp.sum(jnp.sign(u), axis=0)),
                     stacked_updates)
 
@@ -101,12 +122,16 @@ def trmean_k(trim_k: int, m: int) -> int:
     return max(0, min(int(trim_k), (m - 1) // 2))
 
 
-def agg_trmean(stacked_updates, trim_k: int):
+def agg_trmean(stacked_updates, trim_k: int, mask=None):
     """Coordinate-wise trimmed mean: drop the trim_k smallest and largest
     values per coordinate, average the rest (framework extension; standard
     robust aggregation, Yin et al. 2018 — not in the reference, which has
     avg/comed/sign only). trim_k is clamped so at least one value remains;
     trim_k=0 degrades to the unweighted mean."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        return masking.masked_trmean(stacked_updates, mask, trim_k)
     m = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
     k = trmean_k(trim_k, m)
 
@@ -116,9 +141,13 @@ def agg_trmean(stacked_updates, trim_k: int):
     return tree.map(leaf, stacked_updates)
 
 
-def agg_krum(stacked_updates, num_corrupt: int = 0):
+def agg_krum(stacked_updates, num_corrupt: int = 0, mask=None):
     """Krum: select the update with the smallest sum of its m-f-2 nearest
     squared distances (framework extension; BASELINE.json configs[4])."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        return masking.masked_krum(stacked_updates, mask, num_corrupt)
     d = _pairwise_sq_dists(stacked_updates)
     m = d.shape[0]
     k = max(m - num_corrupt - 2, 1)
@@ -148,7 +177,8 @@ def agent_sq_dists(stacked_updates, center):
     return total
 
 
-def agg_rfa(stacked_updates, iters: int = RFA_ITERS, eps: float = RFA_EPS):
+def agg_rfa(stacked_updates, iters: int = RFA_ITERS, eps: float = RFA_EPS,
+            mask=None):
     """Geometric median of the updates via the smoothed Weiszfeld algorithm
     (RFA, Pillutla et al., IEEE TSP 2022 — framework extension; the
     reference ships avg/comed/sign only, src/aggregation.py:57-75).
@@ -156,6 +186,10 @@ def agg_rfa(stacked_updates, iters: int = RFA_ITERS, eps: float = RFA_EPS):
     Starts from the unweighted mean; each of the `iters` fixed iterations
     reweights agents by 1/max(||u_k - v||, eps) and recomputes the weighted
     mean. Fixed iteration count keeps the compiled program static."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        return masking.masked_rfa(stacked_updates, mask, iters, eps)
     v = tree.map(lambda u: jnp.mean(u.astype(jnp.float32), axis=0),
                  stacked_updates)
     for _ in range(iters):
@@ -179,9 +213,17 @@ def gaussian_noise_like(params_like, key, std: float):
     return jax.tree_util.tree_unflatten(treedef, noisy)
 
 
-def aggregate_updates(stacked_updates, data_sizes, cfg, key):
-    """Dispatch on cfg.aggr + optional noise (src/aggregation.py:26-35)."""
-    if cfg.aggr == "avg":
+def aggregate_updates(stacked_updates, data_sizes, cfg, key, mask=None):
+    """Dispatch on cfg.aggr + optional noise (src/aggregation.py:26-35).
+
+    `mask` ([m] bool participation mask, faults/masking.py) routes every
+    rule through its masked variant; None is the dense path, bit-for-bit
+    the pre-faults behavior."""
+    if mask is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        agg = masking.masked_aggregate(stacked_updates, data_sizes, cfg, mask)
+    elif cfg.aggr == "avg":
         agg = agg_avg(stacked_updates, data_sizes)
     elif cfg.aggr == "comed":
         agg = agg_comed(stacked_updates)

@@ -1,0 +1,25 @@
+"""JAX version-compat shims for the parallel layer.
+
+`shard_map` graduated from `jax.experimental.shard_map` to the `jax.*`
+namespace (and its replication-check kwarg was renamed `check_rep` ->
+`check_vma` in the move). The repo targets the public `jax.shard_map`
+surface; on installs that predate it (e.g. the pinned 0.4.37 toolchain)
+this module adapts the call to the experimental entry point so one code
+path serves both."""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` when available, else the experimental equivalent.
+
+    `check_vma` maps onto the experimental API's `check_rep` (same switch,
+    renamed at graduation); callers use the new-world name only."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

@@ -44,6 +44,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     RFA_EPS, RFA_ITERS, agent_sq_dists, apply_aggregate, gaussian_noise_like,
     sq_dist_accum, trmean_k)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.compat import (
+    shard_map)
 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
     AGENTS_AXIS)
 
@@ -68,12 +70,28 @@ def _from_param_shard(chunk, L, leaf_shape):
     return full[:L].reshape(leaf_shape)
 
 
-def _sharded_aggregate(updates, sizes, cfg, d, key):
+def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
+                       mask_full=None):
     """Aggregation rules as collectives. `updates` leaves are the local block
-    [m/d, ...]; `d` is the mesh size; returns the replicated aggregate."""
+    [m/d, ...]; `d` is the mesh size; returns the replicated aggregate.
+
+    The faults path passes the participation mask twice: `mask_local`
+    ([m/d] bool, this device's agent block) zeroes local rows/weights
+    before the psums, and `mask_full` ([m] bool, replicated — every device
+    derives the identical draw from the replicated fault key) drives the
+    sentinel/index arithmetic on the all_to_all-transposed [m, c] chunks.
+    None/None is the dense path, bit-for-bit the pre-faults behavior."""
     ax = AGENTS_AXIS
+    masked = mask_local is not None
+    if masked:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        n_eff = masking.count(mask_full)
     if cfg.aggr == "avg":
         w = sizes.astype(jnp.float32)
+        if masked:
+            w = jnp.where(mask_local, w, 0.0)
+            updates = masking.zero_masked(updates, mask_local)
         total = jax.lax.psum(jnp.sum(w), ax)
 
         def leaf(u):
@@ -82,6 +100,9 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
                                 ax) / total
         agg = tree.map(leaf, updates)
     elif cfg.aggr == "sign":
+        if masked:
+            # zeroed rows vote sign(0) = 0 in the psum
+            updates = masking.zero_masked(updates, mask_local)
         agg = tree.map(
             lambda u: jnp.sign(jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), ax)),
             updates)
@@ -90,7 +111,10 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
 
         def leaf(u):
             chunk, L = _to_param_shards(u, d)            # [m, c]
-            med = jnp.sort(chunk, axis=0)[(m - 1) // 2]  # torch lower median
+            if masked:
+                med = masking.median_rows(chunk, mask_full, n_eff)
+            else:
+                med = jnp.sort(chunk, axis=0)[(m - 1) // 2]  # lower median
             return _from_param_shard(med, L, u.shape[1:])
         agg = tree.map(leaf, updates)
     elif cfg.aggr == "trmean":
@@ -102,11 +126,18 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
 
         def leaf(u):
             chunk, L = _to_param_shards(u, d)            # [m, c]
-            band = jnp.sort(chunk, axis=0)[k:m - k]
-            return _from_param_shard(jnp.mean(band, axis=0), L, u.shape[1:])
+            if masked:
+                band_mean = masking.trimmed_mean_rows(
+                    chunk, mask_full, n_eff, cfg.num_corrupt)
+            else:
+                band_mean = jnp.mean(jnp.sort(chunk, axis=0)[k:m - k], axis=0)
+            return _from_param_shard(band_mean, L, u.shape[1:])
         agg = tree.map(leaf, updates)
     elif cfg.aggr == "krum":
         m = cfg.agents_per_round
+        if masked:
+            # garbage payloads must not poison the distance matrix
+            updates = masking.zero_masked(updates, mask_local)
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         shards = [_to_param_shards(u, d) for u in leaves]
         # chunk-partial pairwise squared distances; psum over the mesh axis
@@ -115,9 +146,12 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
         for chunk, _ in shards:
             dist = sq_dist_accum(dist, chunk)
         dist = jnp.maximum(jax.lax.psum(dist, ax), 0.0)
-        k = max(m - cfg.num_corrupt - 2, 1)
-        srt = jnp.sort(dist, axis=1)
-        best = jnp.argmin(jnp.sum(srt[:, 1:k + 1], axis=1))
+        if masked:
+            best = masking.krum_best(dist, mask_full, n_eff, cfg.num_corrupt)
+        else:
+            k = max(m - cfg.num_corrupt - 2, 1)
+            srt = jnp.sort(dist, axis=1)
+            best = jnp.argmin(jnp.sum(srt[:, 1:k + 1], axis=1))
         agg = jax.tree_util.tree_unflatten(treedef, [
             _from_param_shard(chunk[best], L, u.shape[1:])
             for (chunk, L), u in zip(shards, leaves)])
@@ -128,12 +162,21 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
         # exactly two psums (weighted sum + weight total) over ICI — no
         # transpose needed
         m = cfg.agents_per_round
+        if masked:
+            updates = masking.zero_masked(updates, mask_local)
+            # reciprocal-multiply matches the dense divide-by-constant
+            # after XLA strength reduction (faults/masking.py)
+            denom = 1.0 / masking.count_f32(mask_full)
+            w_base = mask_local.astype(jnp.float32)
+        else:
+            denom = 1.0 / m
+            w_base = 1.0
         v = tree.map(
             lambda u: jax.lax.psum(jnp.sum(u.astype(jnp.float32), axis=0),
-                                   ax) / m, updates)
+                                   ax) * denom, updates)
         for _ in range(RFA_ITERS):
-            w = 1.0 / jnp.maximum(jnp.sqrt(agent_sq_dists(updates, v)),
-                                  RFA_EPS)
+            w = w_base / jnp.maximum(jnp.sqrt(agent_sq_dists(updates, v)),
+                                     RFA_EPS)
             wsum = jax.lax.psum(jnp.sum(w), ax)
 
             def leaf(u, w=w, wsum=wsum):
@@ -148,13 +191,23 @@ def _sharded_aggregate(updates, sizes, cfg, d, key):
         # key is replicated across devices -> identical noise everywhere
         agg = tree.add(agg, gaussian_noise_like(agg, key,
                                                 cfg.noise * cfg.clip))
+    if masked:
+        # all payloads dropped/rejected -> zero aggregate (noise included),
+        # making the round a full no-op — matches the vmap path's guard
+        agg = masking.guard_empty(agg, mask_full)
     return agg
 
 
-def _sharded_robust_lr(updates, cfg):
+def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None):
     """RLR sign-agreement vote as a psum (src/aggregation.py:48-54 semantics,
-    vote over exactly the m sampled agents)."""
+    vote over exactly the m sampled agents — minus masked-out voters on the
+    faults path, where the threshold may also scale with the electorate)."""
     thr = float(cfg.robustLR_threshold)
+    if mask_local is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        updates = masking.zero_masked(updates, mask_local)
+        thr = masking.rlr_threshold(cfg, mask_full)
     slr = cfg.effective_server_lr
 
     def leaf(u):
@@ -203,30 +256,68 @@ def _sharded_pallas_apply(params, updates, sizes, cfg):
 
 
 def _build_sharded_body(cfg, model, normalize, mesh):
-    """The shard_mapped round body shared by the per-round and chained fns."""
+    """The shard_mapped round body shared by the per-round and chained fns.
+
+    With faults configured the body takes a trailing replicated [m] bool
+    `corrupt_flags` input: every device derives the IDENTICAL fault draw
+    from the replicated fault key (faults/model.py — no collective needed
+    to agree on who failed), slices its local block of the draw by mesh
+    position, and the only added communication is one tiny all_gather of
+    the per-device payload-validation bits."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         _pallas_applicable)
+    faults_on = cfg.faults_enabled
+    if faults_on:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            model as fmodel)
     local_train = make_local_train(model, cfg, normalize)
     m = cfg.agents_per_round
     d = mesh.devices.size
     assert m % d == 0, f"agents_per_round={m} not divisible by mesh size {d}"
+    mb = m // d
 
-    def shard_body(params, imgs, lbls, szs, keys, noise_key):
+    def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
+        mask_local = mask_full = draw = ep_local = None
+        if faults_on:
+            # replicated draw: every device computes the same [m] pattern
+            draw = fmodel.sample_faults(cfg, fmodel.fault_key(noise_key), m,
+                                        rest[0])
+            pos = jax.lax.axis_index(AGENTS_AXIS) * mb
+
+            def local(v):
+                return jax.lax.dynamic_slice_in_dim(v, pos, mb, 0)
+            if cfg.straggler_rate > 0:
+                ep_local = local(draw.ep_budget)
         # chunking applies to the per-device agent block (m/d agents)
         updates, losses = vmap_agents(local_train, params, imgs, lbls, szs,
-                                      keys, cfg.agent_chunk)
+                                      keys, cfg.agent_chunk,
+                                      ep_budget=ep_local)
+        if faults_on:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+                masking)
+            if cfg.corrupt_rate > 0:
+                updates = fmodel.inject_corrupt(updates, local(draw.corrupt),
+                                                cfg.corrupt_mode)
+            valid = jax.lax.all_gather(
+                fmodel.payload_valid(updates, cfg.payload_norm_cap),
+                AGENTS_AXIS, axis=0, tiled=True)
+            mask_full = draw.participate & valid
+            mask_local = local(mask_full)
         if _pallas_applicable(cfg):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
             return new_params, loss, {}
         if cfg.robustLR_threshold > 0:
-            lr = _sharded_robust_lr(updates, cfg)
+            lr = _sharded_robust_lr(updates, cfg, mask_local, mask_full)
         else:
             lr = cfg.effective_server_lr
-        agg = _sharded_aggregate(updates, szs, cfg, d, noise_key)
+        agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
+                                 mask_local, mask_full)
         new_params = apply_aggregate(params, lr, agg)
         loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
         extras = {}
+        if faults_on:
+            extras.update(fmodel.fault_scalars(draw, mask_full))
         if cfg.diagnostics:
             from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
                 per_agent_norms)
@@ -238,15 +329,20 @@ def _build_sharded_body(cfg, model, normalize, mesh):
         return new_params, loss, extras
 
     extras_specs = {}
+    if faults_on:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+            FAULT_INFO_KEYS)
+        extras_specs.update({k: P() for k in FAULT_INFO_KEYS})
     if cfg.diagnostics:
         extras_specs["agent_norms"] = P()
         if cfg.robustLR_threshold > 0:
             extras_specs["lr_flat"] = P()
 
-    return jax.shard_map(
+    in_specs = (P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
+                P(AGENTS_AXIS), P()) + ((P(),) if faults_on else ())
+    return shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
-                  P(AGENTS_AXIS), P()),
+        in_specs=in_specs,
         out_specs=(P(), P(), extras_specs),
         check_vma=False)
 
@@ -271,8 +367,9 @@ def _make_sample_step(cfg, model, normalize, mesh):
         lbls = jnp.take(labels, sampled, axis=0)
         szs = jnp.take(sizes, sampled, axis=0)
         agent_keys = jax.random.split(k_train, m)
+        extra = ((sampled < cfg.num_corrupt,) if cfg.faults_enabled else ())
         new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
-                                                 agent_keys, k_noise)
+                                                 agent_keys, k_noise, *extra)
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
@@ -299,6 +396,17 @@ def make_sharded_host_step(cfg, model, normalize, mesh):
     host paths are comparable round-for-round."""
     sharded = _build_sharded_body(cfg, model, normalize, mesh)
     m = cfg.agents_per_round
+
+    if cfg.faults_enabled:
+        # faults: the driver passes the sampled slots' corrupt flags (it
+        # owns the host-side id sampling) — see fl/rounds.make_host_step
+        def step(params, key, imgs, lbls, szs, corrupt_flags):
+            k_train, k_noise = jax.random.split(key)
+            agent_keys = jax.random.split(k_train, m)
+            new_params, train_loss, extras = sharded(
+                params, imgs, lbls, szs, agent_keys, k_noise, corrupt_flags)
+            return new_params, {"train_loss": train_loss, **extras}
+        return step
 
     def step(params, key, imgs, lbls, szs):
         k_train, k_noise = jax.random.split(key)

@@ -28,7 +28,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
     make_eval_fn, pad_eval_set)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-    make_round_fn, make_round_fn_host)
+    FAULT_INFO_KEYS, make_round_fn, make_round_fn_host)
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -229,7 +229,14 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         # one site builds the chained-host variant for whichever round fn
         # was picked above (sharded single- or multi-process mesh, or
         # single-device); a multi-process job WITHOUT the global mesh gets
-        # no chaining (it is the redundant-work warning case below)
+        # no chaining (it is the redundant-work warning case below).
+        # Host-sampled chaining is also skipped under faults: the host step
+        # then takes per-round corrupt flags the chained scan doesn't carry
+        # (device-resident chaining computes them in-jit and is unaffected).
+        if chain_n > 1 and cfg.faults_enabled:
+            chain_n = 1
+            print("[faults] host-sampled mode: --chain disabled (per-round "
+                  "corrupt flags ride each dispatch)")
         if chain_n > 1:
             if n_mesh > 1:
                 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
@@ -286,7 +293,13 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         def host_sampler(params, key, rnd, want_diag):
             ids, imgs, lbls, szs = get_unit((rnd,))
             fn = diag_round_fn_host if want_diag else round_fn_host
-            new_params, info = fn(params, key, imgs, lbls, szs)
+            if cfg.faults_enabled:
+                # faults: the host-sampled ids determine which slots hold
+                # malicious agents (--faults_spare_corrupt participation)
+                flags = jnp.asarray(ids < cfg.num_corrupt)
+                new_params, info = fn(params, key, imgs, lbls, szs, flags)
+            else:
+                new_params, info = fn(params, key, imgs, lbls, szs)
             info["sampled"] = ids
             return new_params, info
     else:
@@ -304,6 +317,14 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan"
               + (", host-sampled blocks)" if host_chained_fn is not None
                  else ")"))
+
+    if cfg.faults_enabled:
+        print(f"[faults] dropout={cfg.dropout_rate} "
+              f"straggler={cfg.straggler_rate}@{cfg.straggler_epochs}ep "
+              f"corrupt={cfg.corrupt_rate}/{cfg.corrupt_mode} "
+              f"norm_cap={cfg.payload_norm_cap} "
+              f"rlr_threshold={cfg.rlr_threshold_mode}"
+              + (" spare_corrupt" if cfg.faults_spare_corrupt else ""))
 
     if jax.process_count() > 1 and n_mesh <= 1:
         # no global-mesh SPMD path was taken: every process would run the
@@ -417,6 +438,8 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 rnd = unit[-1]
                 rounds_done += len(unit)
                 info = {"train_loss": stacked["train_loss"][-1]}
+                info.update({k: stacked[k][-1] for k in FAULT_INFO_KEYS
+                             if k in stacked})
                 want_diag, prev_params = False, None
             else:
                 rnd = unit[0]
@@ -473,6 +496,15 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 writer.scalar("Poison/Cumulative_Poison_Accuracy_Mean",
                               cum_poison_acc / rnd, rnd)
                 writer.scalar("Train/Loss", float(info["train_loss"]), rnd)
+                if "fault_voters" in info:
+                    # degradation observability (faults/): who failed this
+                    # round, and how thin the aggregation electorate got
+                    writer.scalar("Faults/Dropped",
+                                  float(info["fault_dropped"]), rnd)
+                    writer.scalar("Faults/Straggled",
+                                  float(info["fault_straggled"]), rnd)
+                    writer.scalar("Faults/Effective_Voters",
+                                  float(info["fault_voters"]), rnd)
                 elapsed = time.perf_counter() - t_loop
                 writer.scalar("Throughput/Rounds_Per_Sec",
                               rounds_done / elapsed, rnd)

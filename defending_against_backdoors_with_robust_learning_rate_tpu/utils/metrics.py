@@ -23,12 +23,19 @@ from typing import Optional
 
 def run_name(cfg) -> str:
     """Hyperparam-derived run dir name (src/federated.py:27-31, minus the
-    duplicated num_corrupt quirk, SURVEY.md 2.3.9)."""
-    return (f"time:{time.ctime().replace(' ', '_')}-clip_val:{cfg.clip}"
+    duplicated num_corrupt quirk, SURVEY.md 2.3.9, and minus the
+    reference's time.ctime() prefix: the name is a pure function of the
+    config, so two runs of the same --seed land in the same directory and
+    their metrics.jsonl streams can be diffed directly)."""
+    faults = ""
+    if cfg.faults_enabled:
+        faults = (f"-flt:d{cfg.dropout_rate}"
+                  f"s{cfg.straggler_rate}c{cfg.corrupt_rate}")
+    return (f"clip_val:{cfg.clip}"
             f"-noise_std:{cfg.noise}-aggr:{cfg.aggr}"
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
-            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}")
+            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}{faults}")
 
 
 class NullWriter:
@@ -61,6 +68,11 @@ class MetricsWriter:
                 self._tb = SummaryWriter(self.dir)
             except Exception:
                 self._tb = None
+        # deterministic run_name means reruns of one config share this file
+        # (resume appends by design); a boundary record lets readers split
+        # the stream into runs instead of seeing duplicate (tag, step) rows
+        self._jsonl.write(json.dumps(
+            {"tag": "_run/start", "value": time.time(), "step": -1}) + "\n")
 
     def scalar(self, tag: str, value, step: int) -> None:
         self._jsonl.write(json.dumps(

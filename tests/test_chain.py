@@ -5,6 +5,7 @@ with per-round dispatch: round r's key is fold_in(base_key, r) in both paths
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
 from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
@@ -61,6 +62,9 @@ def test_chained_matches_per_round_dispatch():
     assert stacked["sampled"].shape == (n, cfg.agents_per_round)
 
 
+@pytest.mark.slow  # knob variant of test_chained_matches_per_round_
+# dispatch (clip+noise only change the round body, not the chain
+# machinery); ~40s of CPU compile
 def test_chained_matches_per_round_with_clip_and_noise():
     """The r4 clip+noise sweep row runs chained: per-batch PGD projection
     and the server's Gaussian noise (k_noise split from the round key) must
@@ -202,6 +206,9 @@ def test_dispatch_schedule_covers_rounds_in_order():
             start, total, snap, chain_n, diag, False))
 
 
+@pytest.mark.slow  # three driver runs (~30s); the host-chain fn-level
+# parity stays in tier-1 (test_host_chained_matches_per_round_host) and
+# the schedule logic is unit-tested (test_dispatch_schedule_*)
 def test_run_host_chain_matches_unchained(tmp_path):
     """Driver-level: host-sampled mode with --chain must produce the same
     curve as unchained host-sampled mode (same sampling sequence, same keys),

@@ -125,6 +125,8 @@ def test_driver_agent_chunk_parity():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # chunk semantics covered unsharded above; the
+# chunk+mesh combination costs ~30s of CPU compile
 def test_driver_agent_chunk_parity_sharded():
     """Chunking applies per-device on the mesh path (2 agents/device on the
     8-device mesh, chunk=1 -> 2 sequential chunks per device)."""
@@ -137,6 +139,8 @@ def test_driver_agent_chunk_parity_sharded():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # scale smoke; krum-on-mesh math is covered by
+# test_parallel + test_faults harnesses
 def test_driver_256_agent_krum_on_mesh():
     """BASELINE configs[4] shape scaled to CI: 256 agents (32/device on the
     faked 8-device mesh), 10% corrupt, krum aggregation via the
@@ -191,6 +195,8 @@ def test_driver_rng_impl_rbg():
         jax.config.update("jax_default_prng_impl", "threefry2x32")
 
 
+@pytest.mark.slow  # diag-rounds-stay-unchained is pinned by the
+# dispatch_schedule unit test; this drives it e2e (~20s)
 def test_driver_host_chain_with_diagnostics(monkeypatch, capsys):
     """diagnostics + host-sampled + --chain: the dispatch schedule must keep
     every snap round unchained (it needs prev_params + the diag-compiled

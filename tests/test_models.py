@@ -12,6 +12,7 @@ Reference CNN_CIFAR (src/models.py:33-58):
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
@@ -85,6 +86,7 @@ def test_resnet9_is_the_north_star_default_for_cifar():
     assert type(get_model("fmnist", "auto")).__name__ == "CNN_MNIST"
 
 
+@pytest.mark.slow  # ResNet-9 fwd+bwd compiled twice (~25s on CI CPU)
 def test_resnet9_remat_matches_unremated():
     """Blockwise rematerialization (HBM lever for the 40-agent cifar
     configs) is exact: same param tree, same loss, same grads."""

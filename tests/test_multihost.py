@@ -88,6 +88,9 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow  # the pinned jax's XLA:CPU cannot run cross-process
+# collectives ("Multiprocess computations aren't implemented on the CPU
+# backend") — needs a real multi-host TPU/GPU backend
 def test_two_process_host_sampled_trains():
     """Multi-process host-sampled mode: the fedemnist-scale gather path
     distributed over a 2-process global mesh (train.py host_mode branch,
@@ -136,6 +139,7 @@ def test_two_process_host_sampled_trains():
     assert 0.0 <= summaries[0]["val_acc"] <= 1.0
 
 
+@pytest.mark.slow  # same CPU-backend gate as above
 def test_two_process_global_mesh_trains(tmp_path):
     port = _free_port()
     coord = f"127.0.0.1:{port}"
