@@ -109,6 +109,20 @@ class Config:
                                     # scaled: threshold * n_eff / m keeps
                                     # the required agreement fraction
                                     # invariant under churn
+    # --- compile persistence & async dispatch (utils/compile_cache.py) ---
+    compile_cache: bool = True      # persistent XLA cache + serialized-
+                                    # executable AOT bank (warm starts skip
+                                    # XLA entirely); --no_compile_cache
+                                    # opts out
+    compile_cache_dir: str = ""     # cache root ("" = $RLR_COMPILE_CACHE_DIR
+                                    # or ~/.cache/rlr_fl — stable across
+                                    # runs by design)
+    async_metrics: bool = True      # per-round scalars stay on device and
+                                    # drain on a background thread (no
+                                    # blocking host sync in the round
+                                    # loop); --sync_metrics opts out.
+                                    # Diagnostics/debug_nan/multi-process
+                                    # runs are always synchronous.
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -303,6 +317,17 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.rlr_threshold_mode,
                    help="RLR vote threshold under faults: abs = paper's "
                         "absolute count; scaled = threshold * n_eff / m")
+    p.add_argument("--no_compile_cache", action="store_true",
+                   help="disable the persistent XLA compilation cache and "
+                        "the serialized-executable AOT bank "
+                        "(utils/compile_cache.py)")
+    p.add_argument("--compile_cache_dir", type=str, default=d.compile_cache_dir,
+                   help="compile-cache root (default: $RLR_COMPILE_CACHE_DIR "
+                        "or ~/.cache/rlr_fl)")
+    p.add_argument("--sync_metrics", action="store_true",
+                   help="force the synchronous metrics path (float() host "
+                        "sync every eval boundary) instead of the async "
+                        "background drain")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--log_dir", type=str, default=d.log_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
@@ -339,6 +364,8 @@ def args_parser(argv: Optional[list] = None) -> Config:
     fields = {f.name for f in dataclasses.fields(Config)}
     kw = {k: v for k, v in vars(ns).items() if k in fields}
     kw["tensorboard"] = not ns.no_tensorboard
+    kw["compile_cache"] = not ns.no_compile_cache
+    kw["async_metrics"] = not ns.sync_metrics
     return Config(**kw)
 
 

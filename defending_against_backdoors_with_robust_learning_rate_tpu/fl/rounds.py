@@ -157,7 +157,7 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     return new_params, jnp.mean(losses), extras
 
 
-def make_chained(step, data):
+def make_chained(step, data, family: str = "chained"):
     """Wrap a step(params, key, *data) fn into chained(params, base_key,
     round_ids): a `lax.scan` over rounds, round r keyed by
     `fold_in(base_key, r)` (the driver loop's exact derivation — chained
@@ -189,6 +189,7 @@ def make_chained(step, data):
         return chained(params, base_key, round_ids, *data)
 
     bound.jitted, bound.data = chained, data   # for lowering-size tests
+    bound.family = family   # AOT manifest name (utils/compile_cache.py)
     return bound
 
 
@@ -226,7 +227,7 @@ def _make_sample_step(cfg, model, normalize):
     return step
 
 
-def bind_data(step_jit, data):
+def bind_data(step_jit, data, family: str = "round"):
     """(params, key, *data) jitted fn -> (params, key) fn with the dataset
     stacks bound at call time (passed as jit arguments every call; one
     compilation serves every round since shapes never change)."""
@@ -234,6 +235,7 @@ def bind_data(step_jit, data):
         return step_jit(params, key, *data)
 
     bound.jitted, bound.data = step_jit, data   # for lowering-size tests
+    bound.family = family   # AOT manifest name (utils/compile_cache.py)
     return bound
 
 
@@ -243,7 +245,8 @@ def make_round_fn(cfg, model, normalize, images, labels, sizes):
     images/labels/sizes are the full K-agent stacked arrays (jnp, on device).
     """
     return bind_data(jax.jit(_make_sample_step(cfg, model, normalize)),
-                     (images, labels, sizes))
+                     (images, labels, sizes),
+                     family="round_diag" if cfg.diagnostics else "round")
 
 
 def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):

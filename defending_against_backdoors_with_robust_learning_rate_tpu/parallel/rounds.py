@@ -385,7 +385,9 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
     partitioned over the mesh by shard_map's in_specs.
     """
     return bind_data(jax.jit(_make_sample_step(cfg, model, normalize, mesh)),
-                     (images, labels, sizes))
+                     (images, labels, sizes),
+                     family=("round_sharded_diag" if cfg.diagnostics
+                             else "round_sharded"))
 
 
 def make_sharded_host_step(cfg, model, normalize, mesh):
@@ -451,4 +453,4 @@ def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
     fl/rounds.make_chained_round_fn). Diagnostics extras unsupported."""
     return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
                                           model, normalize, mesh),
-                        (images, labels, sizes))
+                        (images, labels, sizes), family="chained_sharded")

@@ -1,0 +1,438 @@
+"""Compile persistence + ahead-of-time (AOT) executable banking.
+
+The flagship configs run hundreds of FL rounds per experiment row, yet every
+session used to pay the full XLA compile cost again (BENCH_r05.json: 164.3s
+on the CPU fallback; ~60-70s per TPU program family). FedJAX
+(arXiv:2108.02117) treats cached compilation of the round program as a
+first-class requirement for FL-simulation throughput; this module is that
+requirement, in two layers:
+
+1. **Persistent XLA cache** (`enable_persistent_cache`): wires JAX's
+   `jax_compilation_cache_dir` so every `jit` compilation — including ones
+   this module never sees — warm-starts from disk across processes.
+2. **Executable bank** (`AotBank`): `lower().compile()` each program family
+   the run will use ahead of time and serialize the *executable itself*
+   (`jax.experimental.serialize_executable`), keyed by a fingerprint of
+   (config, jax version, backend, topology, arg shapes). A warm start
+   deserializes the banked executable and skips XLA entirely — no trace,
+   no lowering, no compile. This also de-risks the documented
+   tunnel-wedge failure mode: `scripts/precompile.py` banks all families
+   once, offline, before any watchdog arms, so session scripts never kill
+   a first-time compile mid-flight again.
+
+Program families (the manifest vocabulary; see `plan_programs`):
+
+    round / round_diag      device-resident per-round fn (fl/rounds.py)
+    chained                 device-resident lax.scan round block
+    round_host[_diag]       host-sampled per-round fn
+    chained_host            host-sampled chained block
+    round_sharded /         shard_map variants (parallel/rounds.py) —
+    chained_sharded         adopted at runtime, banked best-effort
+    eval_val / eval_poison  the two eval-set program instances
+
+Every entry is a pair of files in `<root>/aot/`: `<family>-<fp>.jex`
+(pickled serialized executable + arg pytree defs) and a `<family>-<fp>.json`
+sidecar (the manifest record: fingerprint inputs, compile seconds, backend).
+Per-entry files make concurrent writers safe without locking — the manifest
+IS the directory. A changed config, jax version, backend, topology or arg
+shape changes the fingerprint, so stale executables are never loaded; they
+are simply dead files.
+
+Failure policy: every load path degrades to the plain jit path with a log
+line — a corrupt or version-skewed bank can cost a recompile, never a run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# config fields that do not change the compiled program (pure IO/driver
+# knobs). `snap`/`rounds`/`seed`/`chain` only alter which/how many
+# dispatches run; shapes (which DO change programs, e.g. the chained
+# block's round_ids length) enter the fingerprint through the
+# example-argument avals instead.
+EXCLUDED_FIELDS = frozenset({
+    "data_dir", "log_dir", "checkpoint_dir", "resume", "profile_dir",
+    "tensorboard", "rounds", "snap", "seed", "chain", "host_prefetch",
+    "compile_cache", "compile_cache_dir", "async_metrics",
+})
+
+# families built from cfg.replace(diagnostics=False) in the driver; their
+# fingerprints normalize diagnostics off so a --diagnostics run still hits
+# the same banked non-diag executables
+_DIAG_FAMILIES = frozenset({"round_diag", "round_host_diag",
+                            "round_sharded_diag"})
+
+DEFAULT_CACHE_ROOT = os.path.join("~", ".cache", "rlr_fl")
+
+# above this many stacked-array bytes the driver switches to host-side
+# per-round shard gathering (the fedemnist path; train.py re-exports this)
+DEVICE_RESIDENT_BYTES = 2 << 30
+
+
+def cache_root(cfg=None) -> str:
+    """Resolve the cache root: --compile_cache_dir, else $RLR_COMPILE_CACHE_DIR,
+    else ~/.cache/rlr_fl (stable across runs — that is the point)."""
+    root = ""
+    if cfg is not None:
+        root = getattr(cfg, "compile_cache_dir", "") or ""
+    root = root or os.environ.get("RLR_COMPILE_CACHE_DIR", "")
+    return os.path.expanduser(root or DEFAULT_CACHE_ROOT)
+
+
+def _reset_jax_cache_state() -> None:
+    """jax's persistent-cache module initializes AT MOST ONCE per process:
+    after any compile with the dir unset, a later `jax_compilation_cache_dir`
+    update is silently ignored. Reset to pristine so the next compile
+    re-initializes against the current config."""
+    try:
+        from jax._src import compilation_cache as jax_cc
+        jax_cc.reset_cache()
+    except Exception:
+        pass
+
+
+def enable_persistent_cache(root: Optional[str] = None) -> str:
+    """Point JAX's persistent compilation cache at `<root>/xla`.
+
+    Thresholds are zeroed so every program family persists (the default
+    1s/min-size gates would skip the small eval programs whose compiles
+    still stall a TPU session through the tunnel). Safe to call more than
+    once; returns the cache dir."""
+    xla_dir = os.path.join(root or cache_root(), "xla")
+    os.makedirs(xla_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", xla_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _reset_jax_cache_state()
+    return xla_dir
+
+
+def abstractify(tree):
+    """Pytree of arrays -> matching ShapeDtypeStructs (already-abstract
+    leaves pass through), for zero-materialization `lower()` calls."""
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree)
+
+
+def _arg_shapes(example_args) -> List[Tuple[str, str]]:
+    return [(str(tuple(l.shape)), str(l.dtype))
+            for l in jax.tree_util.tree_leaves(abstractify(example_args))]
+
+
+def fingerprint(cfg, family: str, example_args) -> str:
+    """Cache key for one program family: config fields that shape the
+    program + jax version + backend + topology + PRNG impl + arg avals.
+    Any mismatch is a different key — stale executables can't load."""
+    fields = dataclasses.asdict(cfg)
+    for name in EXCLUDED_FIELDS:
+        fields.pop(name, None)
+    if family not in _DIAG_FAMILIES:
+        fields["diagnostics"] = False
+    meta = {
+        "family": family,
+        "cfg": {k: repr(v) for k, v in sorted(fields.items())},
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+        "prng_impl": str(jax.config.jax_default_prng_impl),
+        # compilation-shaping global config: the test harness runs at
+        # matmul precision 'highest' while production runs at default —
+        # same Config, different compiled math; they must not collide
+        "matmul_precision": str(jax.config.jax_default_matmul_precision),
+        "x64": bool(jax.config.jax_enable_x64),
+        "arg_shapes": _arg_shapes(example_args),
+    }
+    blob = json.dumps(meta, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:20]
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class AotBank:
+    """Serialized-executable store under `<root>/aot/`.
+
+    `get_or_compile` is the single entry point: a fingerprint hit
+    deserializes and returns the banked executable (no XLA); a miss
+    compiles via `lower().compile()` and banks the result for the next
+    process. Returns (compiled, cache_hit, seconds, entry)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.dir = os.path.join(root or cache_root(), "aot")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _base(self, family: str, fp: str) -> str:
+        return os.path.join(self.dir, f"{family}-{fp}")
+
+    def lookup(self, family: str, fp: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._base(family, fp) + ".json") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def load(self, family: str, fp: str):
+        """Deserialize a banked executable, or None (any failure = miss —
+        logged, because a silently recompiling bank looks identical to a
+        working one from the outside)."""
+        from jax.experimental import serialize_executable
+        try:
+            with open(self._base(family, fp) + ".jex", "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            return serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception as e:
+            print(f"[aot] {family}-{fp}: banked executable unloadable "
+                  f"({type(e).__name__}: {e}); recompiling")
+            return None
+
+    # growth bound: fingerprint churn (config/jax-version changes) leaves
+    # dead entries behind; keep the newest MAX_ENTRIES and reap the rest.
+    # Sized above one full tier-1 suite's distinct program families (~64)
+    # so a suite run never evicts entries a later test in the same run
+    # (or the next run) would hit.
+    MAX_ENTRIES = 128
+
+    def _reap(self) -> None:
+        entries = sorted(self.entries(), key=lambda e: e.get("created", 0.0))
+        for e in entries[:-self.MAX_ENTRIES]:
+            for ext in (".jex", ".json"):
+                try:
+                    os.remove(self._base(e["family"], e["fingerprint"])
+                              + ext)
+                except OSError:
+                    pass
+
+    def save(self, family: str, fp: str, compiled, compile_s: float,
+             example_args) -> None:
+        from jax.experimental import serialize_executable
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        base = self._base(family, fp)
+        _atomic_write(base + ".jex",
+                      pickle.dumps((payload, in_tree, out_tree)))
+        entry = {"family": family, "fingerprint": fp,
+                 "jax": jax.__version__,
+                 "backend": jax.default_backend(),
+                 "device_count": jax.device_count(),
+                 "process_count": jax.process_count(),
+                 "compile_s": round(compile_s, 2),
+                 "created": time.time(),
+                 "arg_shapes": _arg_shapes(example_args),
+                 "file": os.path.basename(base) + ".jex"}
+        _atomic_write(base + ".json",
+                      json.dumps(entry, indent=1).encode())
+        self._reap()
+
+    def get_or_compile(self, family: str, cfg, jit_obj, example_args):
+        """(compiled, cache_hit, seconds, entry). `seconds` is the pure
+        executable-acquisition time: deserialize on a hit, trace+lower+
+        compile on a miss (first-call execution is NOT included).
+
+        The miss path compiles with the persistent XLA cache DISABLED: an
+        executable whose compile was served from that cache serializes to
+        a payload missing its jitted symbol definitions on XLA:CPU
+        ("Symbols not found" at deserialize) — the bank must hold
+        self-contained executables. A verify-load after save catches any
+        other unserializable case and deletes the broken artifacts."""
+        fp = fingerprint(cfg, family, example_args)
+        entry = self.lookup(family, fp)
+        if entry is not None:
+            t0 = time.perf_counter()
+            compiled = self.load(family, fp)
+            if compiled is not None:
+                return compiled, True, time.perf_counter() - t0, entry
+        xla_cache_dir = jax.config.jax_compilation_cache_dir
+        t0 = time.perf_counter()
+        try:
+            if xla_cache_dir:
+                jax.config.update("jax_compilation_cache_dir", None)
+                _reset_jax_cache_state()
+            compiled = jit_obj.lower(*abstractify(example_args)).compile()
+        finally:
+            if xla_cache_dir:
+                jax.config.update("jax_compilation_cache_dir",
+                                  xla_cache_dir)
+                _reset_jax_cache_state()
+        secs = time.perf_counter() - t0
+        try:
+            self.save(family, fp, compiled, secs, example_args)
+            if self.load(family, fp) is None:
+                raise RuntimeError("verify-load of the banked executable "
+                                   "failed")
+            entry = self.lookup(family, fp)
+        except Exception as e:  # unserializable backend: still usable AOT
+            for ext in (".jex", ".json"):
+                try:
+                    os.remove(self._base(family, fp) + ext)
+                except OSError:
+                    pass
+            entry = {"family": family, "fingerprint": fp,
+                     "compile_s": round(secs, 2),
+                     "unserializable": f"{type(e).__name__}: {e}"}
+        return compiled, False, secs, entry
+
+    def entries(self) -> List[Dict[str, Any]]:
+        out = []
+        for name in sorted(os.listdir(self.dir)):
+            if name.endswith(".json"):
+                try:
+                    with open(os.path.join(self.dir, name)) as f:
+                        out.append(json.load(f))
+                except (OSError, ValueError):
+                    continue
+        return out
+
+
+def setup(cfg):
+    """Driver/bench entry: enable the persistent XLA cache and return the
+    executable bank, or None when --no_compile_cache (or --debug_nan —
+    checkify-wrapped fns are not plain jits and AOT would bypass them)."""
+    if not getattr(cfg, "compile_cache", True):
+        return None
+    root = cache_root(cfg)
+    enable_persistent_cache(root)
+    if getattr(cfg, "debug_nan", False):
+        return None
+    return AotBank(root)
+
+
+def chain_budget(cfg, host_mode: bool = False) -> int:
+    """Rounds fused per dispatch — the driver's exact budget: capped at
+    `snap` (minus the unchained diagnostic snap round), and 1 in
+    host-sampled mode under faults (per-round corrupt flags ride each
+    dispatch; train.py prints the reason)."""
+    n = max(1, min(cfg.chain, cfg.snap - (1 if cfg.diagnostics else 0)))
+    if host_mode and cfg.faults_enabled:
+        return 1
+    return n
+
+
+def is_host_mode(cfg, fed, threshold: Optional[int] = None) -> bool:
+    """Single source of the driver's host-sampled decision — the
+    precompile planner and train.run must agree on which program families
+    a config dispatches. `threshold` lets the driver pass its own
+    (monkeypatchable) byte budget."""
+    if threshold is None:
+        threshold = DEVICE_RESIDENT_BYTES
+    return (cfg.host_sampled == "on"
+            or (cfg.host_sampled == "auto"
+                and fed.train.images.nbytes > threshold))
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One program family of a run: the jit object to lower and the
+    abstract example arguments that pin its (single) instantiation."""
+    family: str
+    jit_obj: Any
+    example_args: Tuple
+
+
+def plan_programs(cfg, model, norm, fed,
+                  host_mode: Optional[bool] = None) -> List[ProgramSpec]:
+    """Enumerate the program families train.run would dispatch for `cfg`
+    on a single process (the precompile surface). Mirrors the driver's
+    mode selection; the mesh>1 shard_map variants are adopted at runtime
+    only (their executables embed the live mesh) and are not planned here.
+    """
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
+        make_eval_fn, pad_eval_set)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn, make_chained_round_fn_host, make_round_fn,
+        make_round_fn_host)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        init_params)
+
+    if host_mode is None:
+        host_mode = is_host_mode(cfg, fed)
+    image_shape = fed.train.images.shape[2:]
+    params_aval = jax.eval_shape(
+        lambda k: init_params(model, image_shape, k), jax.random.PRNGKey(0))
+    key_aval = abstractify(jax.random.PRNGKey(0))
+    data_avals = abstractify((fed.train.images, fed.train.labels,
+                              fed.train.sizes))
+    chain_n = chain_budget(cfg, host_mode)
+    ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
+    plain = cfg.replace(diagnostics=False)
+    m = cfg.agents_per_round
+    specs: List[ProgramSpec] = []
+
+    if host_mode:
+        shard_avals = tuple(
+            jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
+            for a in data_avals)
+        flags = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
+                 if cfg.faults_enabled else ())
+        specs.append(ProgramSpec(
+            "round_host", make_round_fn_host(plain, model, norm),
+            (params_aval, key_aval) + shard_avals + flags))
+        if cfg.diagnostics:
+            specs.append(ProgramSpec(
+                "round_host_diag", make_round_fn_host(cfg, model, norm),
+                (params_aval, key_aval) + shard_avals + flags))
+        if chain_n > 1:
+            block_avals = tuple(
+                jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
+                for a in shard_avals)
+            specs.append(ProgramSpec(
+                "chained_host",
+                make_chained_round_fn_host(plain, model, norm),
+                (params_aval, key_aval, ids_aval) + block_avals))
+    else:
+        specs.append(ProgramSpec(
+            "round", make_round_fn(plain, model, norm, *data_avals).jitted,
+            (params_aval, key_aval) + data_avals))
+        if cfg.diagnostics:
+            specs.append(ProgramSpec(
+                "round_diag",
+                make_round_fn(cfg, model, norm, *data_avals).jitted,
+                (params_aval, key_aval) + data_avals))
+        if chain_n > 1:
+            specs.append(ProgramSpec(
+                "chained",
+                make_chained_round_fn(plain, model, norm,
+                                      *data_avals).jitted,
+                (params_aval, key_aval, ids_aval) + data_avals))
+
+    eval_fn = make_eval_fn(model, norm, cfg.n_classes)
+    for family, (imgs, lbls) in (
+            ("eval_val", (fed.val_images, fed.val_labels)),
+            ("eval_poison", (fed.pval_images, fed.pval_labels))):
+        eval_avals = abstractify(pad_eval_set(imgs, lbls, cfg.eval_bs))
+        specs.append(ProgramSpec(family, eval_fn,
+                                 (params_aval,) + eval_avals))
+    return specs
+
+
+def precompile(cfg, model, norm, fed, bank: AotBank,
+               log=print) -> List[Dict[str, Any]]:
+    """Bank every planned program family for `cfg`. Idempotent: already-
+    banked families are verified loadable and skipped. Returns the manifest
+    rows (one per family, with cache_hit + seconds)."""
+    rows = []
+    for spec in plan_programs(cfg, model, norm, fed):
+        compiled, hit, secs, entry = bank.get_or_compile(
+            spec.family, cfg, spec.jit_obj, spec.example_args)
+        del compiled
+        verb = "loaded" if hit else "compiled+banked"
+        log(f"[precompile] {spec.family}: {verb} in {secs:.1f}s "
+            f"(fp {entry['fingerprint']})")
+        rows.append({**entry, "cache_hit": hit,
+                     "seconds": round(secs, 2)})
+    return rows

@@ -44,14 +44,20 @@ def _all_finite(params):
          for l in jax.tree_util.tree_leaves(params)]))
 
 
-def assert_finite_params(params, where: str = "",
-                         raise_error: bool = True) -> bool:
-    """Host-side post-round guard: one compiled reduction + one device sync.
+def all_finite_device(params):
+    """Device-side half of the post-round guard: the compiled finite
+    reduction WITHOUT the host sync. The async metrics drain
+    (utils/metrics.MetricsDrain) fetches the scalar in its batched
+    device_get and routes it through `finite_warn` off the round loop's
+    critical path."""
+    return _all_finite(params)
 
-    Returns True when all params are finite. On divergence: raises when
-    `raise_error`, else prints a loud warning and returns False (so sweeps
-    record their NaN metrics instead of aborting)."""
-    finite = bool(_all_finite(params))
+
+def finite_warn(finite, where: str = "", raise_error: bool = True) -> bool:
+    """Host-side half: act on an already-fetched finite flag. Raises when
+    `raise_error`, else prints a loud warning and returns the flag (so
+    sweeps record their NaN metrics instead of aborting)."""
+    finite = bool(finite)
     if not finite:
         msg = (f"non-finite parameters detected"
                f"{' at ' + where if where else ''}"
@@ -60,3 +66,12 @@ def assert_finite_params(params, where: str = "",
             raise FloatingPointError(msg)
         print(f"[guards] WARNING: {msg}")
     return finite
+
+
+def assert_finite_params(params, where: str = "",
+                         raise_error: bool = True) -> bool:
+    """Host-side post-round guard: one compiled reduction + one device sync.
+
+    Returns True when all params are finite. On divergence: raises when
+    `raise_error`, else prints a loud warning and returns False."""
+    return finite_warn(_all_finite(params), where, raise_error)

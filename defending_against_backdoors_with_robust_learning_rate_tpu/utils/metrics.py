@@ -15,8 +15,10 @@ profiling; BASELINE's metric is FL rounds/sec)."""
 
 from __future__ import annotations
 
+import collections
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -50,6 +52,94 @@ class NullWriter:
 
     def close(self) -> None:
         pass
+
+
+class MetricsDrain:
+    """Async host-sync pipeline: the round loop queues callbacks with their
+    *device* values and moves on; a background thread fetches the values
+    (one batched `jax.device_get` across everything queued at that moment —
+    a Podracer-style host loop free of synchronous readbacks) and runs the
+    callbacks in strict FIFO order, so the metrics stream is bit-identical
+    to the synchronous path (tests/test_async_metrics.py pins this).
+
+    Error policy: a callback exception stops the drain, is re-raised at the
+    next flush()/close() on the submitting thread, and later submissions
+    are dropped — metrics can lag, never corrupt silently."""
+
+    def __init__(self):
+        self._items = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = 0
+        self._stop = False
+        self._error = None
+        self._dead = False      # drain thread exited on error: reject work
+        self._thread = None
+
+    def submit(self, fn, device_vals, *host_args) -> None:
+        """Queue fn(fetched_device_vals, *host_args) for the drain thread.
+        `device_vals` may be any pytree of jax arrays (or host scalars)."""
+        with self._cond:
+            if self._dead:
+                return
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="metrics-drain", daemon=True)
+                self._thread.start()
+            self._items.append((fn, device_vals, host_args))
+            self._pending += 1
+            self._cond.notify_all()
+
+    def _loop(self):
+        import jax
+        while True:
+            with self._cond:
+                while not self._items and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._items:
+                    return
+                batch = list(self._items)
+                self._items.clear()
+            try:
+                # ONE transfer for everything queued right now: the whole
+                # batch's device scalars come back in a single device_get
+                fetched = jax.device_get([d for _, d, _ in batch])
+                for (fn, _, host_args), vals in zip(batch, fetched):
+                    fn(vals, *host_args)
+            except BaseException as e:  # noqa: BLE001 — re-raised at flush
+                with self._cond:
+                    self._error = e
+                    self._dead = True
+                    self._pending = 0
+                    self._items.clear()
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self._pending -= len(batch)
+                self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Block until every queued callback has run; re-raise the first
+        drain-thread error on this (the submitting) thread."""
+        with self._cond:
+            while self._pending > 0 and self._error is None:
+                self._cond.wait()
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def close(self, raise_errors: bool = True) -> None:
+        try:
+            self.flush()
+        except BaseException:
+            if raise_errors:
+                raise
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
 
 
 class MetricsWriter:

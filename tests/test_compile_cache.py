@@ -1,0 +1,195 @@
+"""Compile-persistence & AOT executable bank (utils/compile_cache.py).
+
+Covers the PR-2 acceptance surface: executable serialize/deserialize
+round-trip, manifest invalidation on a changed config fingerprint, the
+persistent-cache-dir smoke, the program-family planner, and the
+precompile -> train warm-start handoff (a banked family is LOADED, not
+recompiled, by a subsequent train.run)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+    compile_cache as cc)
+
+TINY = Config(data="synthetic", num_agents=4, bs=32, local_ep=1,
+              synth_train_size=256, synth_val_size=64, eval_bs=64,
+              rounds=4, snap=2, seed=3, tensorboard=False)
+
+
+def _example():
+    return (jax.ShapeDtypeStruct((8, 8), jnp.float32),)
+
+
+def test_fingerprint_stability_and_invalidation():
+    fp = cc.fingerprint(TINY, "round", _example())
+    assert fp == cc.fingerprint(TINY, "round", _example())
+    # program-shaping fields invalidate
+    assert fp != cc.fingerprint(TINY.replace(bs=64), "round", _example())
+    assert fp != cc.fingerprint(TINY.replace(aggr="sign"), "round",
+                                _example())
+    # family and arg shapes are part of the key
+    assert fp != cc.fingerprint(TINY, "chained", _example())
+    assert fp != cc.fingerprint(
+        TINY, "round", (jax.ShapeDtypeStruct((4, 8), jnp.float32),))
+    # pure IO/driver knobs do not (seed/chain/snap/log_dir are excluded)
+    for kw in ({"seed": 9}, {"chain": 7}, {"snap": 5},
+               {"log_dir": "/elsewhere"}, {"rounds": 999},
+               {"async_metrics": False}, {"compile_cache_dir": "/x"}):
+        assert fp == cc.fingerprint(TINY.replace(**kw), "round", _example())
+    # diagnostics normalizes OFF for non-diag families, stays for _diag
+    assert fp == cc.fingerprint(TINY.replace(diagnostics=True), "round",
+                                _example())
+    assert (cc.fingerprint(TINY, "round_diag", _example())
+            != cc.fingerprint(TINY.replace(diagnostics=True), "round_diag",
+                              _example()))
+
+
+def test_bank_roundtrip_and_manifest_invalidation(tmp_path):
+    """Cold compile banks a loadable executable; a fresh bank instance
+    loads it (disk round-trip, no XLA); a changed config fingerprint
+    misses and recompiles."""
+    bank = cc.AotBank(str(tmp_path))
+    jit_obj = jax.jit(lambda x: x @ x.T + 1.0)
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    ex = cc.abstractify((x,))
+
+    compiled, hit, secs, entry = bank.get_or_compile("unit", TINY, jit_obj,
+                                                     ex)
+    assert not hit and entry["compile_s"] >= 0
+    want = np.asarray(jit_obj(x))
+    np.testing.assert_array_equal(np.asarray(compiled(x)), want)
+    names = os.listdir(bank.dir)
+    assert any(n.endswith(".jex") for n in names)
+    assert any(n.endswith(".json") for n in names)
+
+    # fresh bank object = the next process: must LOAD, not recompile
+    bank2 = cc.AotBank(str(tmp_path))
+    loaded, hit2, _, entry2 = bank2.get_or_compile("unit", TINY, jit_obj, ex)
+    assert hit2 and entry2["fingerprint"] == entry["fingerprint"]
+    np.testing.assert_array_equal(np.asarray(loaded(x)), want)
+    assert [e["family"] for e in bank2.entries()] == ["unit"]
+
+    # changed config fingerprint => recompile (manifest invalidation)
+    _, hit3, _, entry3 = bank2.get_or_compile("unit", TINY.replace(bs=64),
+                                              jit_obj, ex)
+    assert not hit3 and entry3["fingerprint"] != entry["fingerprint"]
+    assert len(bank2.entries()) == 2
+
+
+def test_persistent_cache_dir_smoke(tmp_path):
+    """enable_persistent_cache points jax at <root>/xla and compiles land
+    there as cache entries (tier-1 cache-dir smoke)."""
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        xla_dir = cc.enable_persistent_cache(str(tmp_path))
+        assert xla_dir == os.path.join(str(tmp_path), "xla")
+        f = jax.jit(lambda x: jnp.sin(x) @ jnp.cos(x.T))
+        jax.block_until_ready(f(jnp.ones((16, 16))))
+        assert any(n.endswith("-cache") for n in os.listdir(xla_dir))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def _plan_families(cfg, host_mode=None):
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model)
+
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    return [s.family for s in cc.plan_programs(cfg, model, norm, fed,
+                                               host_mode=host_mode)]
+
+
+def test_plan_programs_families():
+    # device-resident, chained: the flagship bench family set
+    assert _plan_families(TINY.replace(chain=2)) == [
+        "round", "chained", "eval_val", "eval_poison"]
+    # unchained (chain budget 1): no chained family
+    assert _plan_families(TINY) == ["round", "eval_val", "eval_poison"]
+    # diagnostics adds the diag variant
+    assert _plan_families(TINY.replace(diagnostics=True)) == [
+        "round", "round_diag", "eval_val", "eval_poison"]
+    # host-sampled mode swaps in the host families
+    assert _plan_families(TINY.replace(chain=2), host_mode=True) == [
+        "round_host", "chained_host", "eval_val", "eval_poison"]
+    # faults disable host chaining (per-round corrupt flags ride each
+    # dispatch — mirrors the driver)
+    assert _plan_families(TINY.replace(chain=2, dropout_rate=0.3),
+                          host_mode=True) == [
+        "round_host", "eval_val", "eval_poison"]
+
+
+def test_precompile_then_train_loads(tmp_path, capsys):
+    """Acceptance: a precompiled family is LOADED (not recompiled) by the
+    subsequent train.run, and the warm run's results equal a cold run's."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu import train
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        NullWriter)
+
+    cfg = TINY.replace(compile_cache_dir=str(tmp_path),
+                       log_dir=str(tmp_path / "logs"))
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    bank = cc.AotBank(str(tmp_path))
+    rows = cc.precompile(cfg, model, norm, fed, bank, log=lambda m: None)
+    assert {r["family"] for r in rows} == {"round", "eval_val",
+                                           "eval_poison"}
+    assert not any(r["cache_hit"] for r in rows)
+
+    summary = train.run(cfg, writer=NullWriter())
+    out = capsys.readouterr().out
+    assert "[aot] round: loaded from cache" in out
+    assert "[aot] eval_val: loaded from cache" in out
+    assert "compiled+banked" not in out   # nothing recompiled
+    assert summary["round"] == cfg.rounds
+
+    # and the warm executables compute the same training as a cache-free run
+    ref = train.run(cfg.replace(compile_cache=False), writer=NullWriter())
+    assert summary["val_acc"] == ref["val_acc"]
+    assert summary["val_loss"] == ref["val_loss"]
+    assert summary["poison_acc"] == ref["poison_acc"]
+
+
+@pytest.mark.slow  # two in-process bench.main runs (~4 min on the CI box)
+def test_bench_cold_then_warm_cache_hit(tmp_path, monkeypatch, capsys):
+    """bench.py acceptance: a second run on a populated cache reports
+    cache_hit true and compile_s_warm <= 20% of compile_s_cold."""
+    import json
+    import bench
+
+    argv = ["bench.py", "--platform", "cpu", "--chain", "2", "--blocks",
+            "1", "--synth_train_size", "2560", "--compile_cache_dir",
+            str(tmp_path)]
+
+    def run_once():
+        monkeypatch.setattr("sys.argv", argv)
+        bench.main()
+        out = [l for l in capsys.readouterr().out.splitlines()
+               if l.startswith("{")]
+        return json.loads(out[-1])
+
+    cold = run_once()
+    assert cold["cache_hit"] is False and cold["compile_s_cold"] > 0
+    warm = run_once()
+    assert warm["cache_hit"] is True
+    assert warm["compile_s_warm"] <= 0.2 * warm["compile_s_cold"]
+    assert warm["host_sync"]["eval_sync_s"] >= warm["host_sync"][
+        "eval_dispatch_s"]

@@ -169,18 +169,22 @@ def test_driver_mesh_device_resident_with_rlr():
 
 def test_driver_reports_steady_throughput():
     """steady_rounds_per_sec: window opens at the first snap boundary and
-    closes at the last one, so first-compile time and a final partial
-    segment's fresh round_fn compile are both excluded (VERDICT r1 #9)."""
+    closes at the last one, so a final partial segment's fresh round_fn
+    compile is excluded (VERDICT r1 #9). Since the AOT bank
+    (utils/compile_cache.py) moved program compiles out of the timed loop
+    entirely — pre-loop on cold runs, skipped on warm — steady and
+    wall-clock rates now only differ by boundary effects, so the old
+    steady >= wall-clock invariant no longer holds; both must simply be
+    present, positive and finite."""
     # rounds=5, snap=2: boundaries at 2 and 4; round 5 is a partial tail
     # (summary["round"] records the last EVALUATED round, i.e. 4)
     cfg = BASE.replace(rounds=5, snap=2, chain=2)
     summary = _run(cfg)
     assert summary["round"] == 4
     assert "steady_rounds_per_sec" in summary
+    assert np.isfinite(summary["steady_rounds_per_sec"])
     assert summary["steady_rounds_per_sec"] > 0
-    # wall-clock figure exists alongside and includes compile, so the
-    # steady figure can only be >= it on these tiny runs
-    assert summary["steady_rounds_per_sec"] >= summary["rounds_per_sec"]
+    assert summary["rounds_per_sec"] > 0
 
 
 def test_driver_rng_impl_rbg():
