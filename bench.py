@@ -195,6 +195,15 @@ def main():
                     help="also measure rounds/sec at 30%% client dropout "
                          "(faults/ masking path) and report the masking "
                          "overhead vs the dense 0%% run")
+    ap.add_argument("--telemetry", choices=("off", "basic", "full"),
+                    default="off",
+                    help="also measure rounds/sec with in-jit defense "
+                         "telemetry (obs/telemetry.py) at this level and "
+                         "report the overhead vs the off run (the "
+                         "headline value stays the off number)")
+    ap.add_argument("--status_file", default="logs/status.json",
+                    help="heartbeat path (obs/heartbeat.py) the session "
+                         "stall detector reads; empty disables")
     ap.add_argument("--remat_policy", choices=("block", "conv", "none"),
                     default="block",
                     help="resnet9 config only: block = full blockwise "
@@ -236,6 +245,16 @@ def main():
             f"{args.bench_config!r} (recorded as ignored_flags in the "
             f"output JSON)")
 
+    # observability (obs/): span-trace the bench phases and heartbeat the
+    # session stall detector through them (status.json replaces the old
+    # stderr-growth liveness heuristic; compile_in_flight marks the window
+    # a watchdog must never kill into)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        Heartbeat, SpanTracer)
+    hb = Heartbeat(args.status_file, enabled=bool(args.status_file))
+    tracer = SpanTracer(on_end=hb.span_hook)
+    hb.update(phase="probe", force=True)
+
     import jax
 
     backend_note = ""
@@ -244,7 +263,8 @@ def main():
         # explicit platform: honor the requested shapes as-is
         jax.config.update("jax_platforms", args.platform)
     else:
-        probed = probe_backend(args.probe_timeout)
+        with tracer.span("bench/probe"):
+            probed = probe_backend(args.probe_timeout)
         if probed is None:
             backend_note = (f"default backend unreachable within "
                             f"{args.probe_timeout:.0f}s (wedged TPU "
@@ -316,7 +336,9 @@ def main():
     device = jax.devices()[0]
     log(f"[bench] devices: {jax.devices()}")
 
-    fed = get_federated_data(cfg)
+    hb.update(phase="data", force=True)
+    with tracer.span("bench/data"):
+        fed = get_federated_data(cfg)
     model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat,
                       remat_policy=cfg.remat_policy)
     norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
@@ -341,14 +363,16 @@ def main():
         base_key = jax.random.PRNGKey(0)
         call, cache_info = chained, None
         acquire_s = 0.0
+        hb.update(phase="compile", compile_in_flight=True, force=True)
         if bank is not None:
             try:
                 ab = compile_cache.abstractify
                 example = (ab(params), ab(base_key),
                            jax.ShapeDtypeStruct((chain,), jnp.int32)
                            ) + ab(arrays)
-                compiled, hit, acquire_s, entry = bank.get_or_compile(
-                    chained.family, mcfg, chained.jitted, example)
+                with tracer.span("bench/aot_acquire", label=label):
+                    compiled, hit, acquire_s, entry = bank.get_or_compile(
+                        chained.family, mcfg, chained.jitted, example)
                 data = chained.data
                 call = lambda p, k, ids: compiled(p, k, ids, *data)  # noqa: E731
                 # cold time comes from THIS run on a miss, and from the
@@ -370,18 +394,22 @@ def main():
         # warmup / first block (post-AOT this is pure execution; on the
         # jit path it still includes the trace+compile)
         t0 = time.perf_counter()
-        params, _ = call(params, base_key, jnp.arange(1, chain + 1))
-        jax.block_until_ready(params)
+        with tracer.span("bench/first_block", label=label):
+            params, _ = call(params, base_key, jnp.arange(1, chain + 1))
+            jax.block_until_ready(params)
         compile_s = time.perf_counter() - t0 + acquire_s
         log(f"[bench]{label} compile+first {chain}-round block: "
             f"{compile_s:.1f}s")
+        hb.update(phase="measure", compile_in_flight=False, force=True)
 
         n_rounds = args.blocks * chain
         t0 = time.perf_counter()
-        for b in range(args.blocks):
-            ids = jnp.arange((b + 1) * chain + 1, (b + 2) * chain + 1)
-            params, _ = call(params, base_key, ids)
-        jax.block_until_ready(params)
+        with tracer.span("bench/steady_blocks", label=label,
+                         blocks=args.blocks):
+            for b in range(args.blocks):
+                ids = jnp.arange((b + 1) * chain + 1, (b + 2) * chain + 1)
+                params, _ = call(params, base_key, ids)
+            jax.block_until_ready(params)
         elapsed = time.perf_counter() - t0
         rounds_per_sec = n_rounds / elapsed
         log(f"[bench]{label} {n_rounds} rounds in {elapsed:.2f}s "
@@ -418,6 +446,34 @@ def main():
         log(f"[bench] masking overhead at 30% dropout: "
             f"{faults_out['masking_overhead_pct']}%")
 
+    telemetry_out = None
+    if args.telemetry != "off":
+        # telemetry-overhead probe (obs/telemetry.py): same config with
+        # in-jit defense telemetry compiled into the round program; the
+        # delta vs the off run is the cost of the extra on-device stats
+        # (the headline `value` stays the off number)
+        r_base = rounds_per_sec
+        if cfg.use_pallas:
+            # telemetry falls back off the fused Pallas server step, so a
+            # pallas-on baseline would fold the kernel's win into
+            # "telemetry overhead" — re-measure unfused
+            log("[bench] --telemetry: re-measuring the off baseline "
+                "without the Pallas kernel for a like-for-like overhead")
+            _, r_base, _, _ = measure(cfg.replace(use_pallas=False),
+                                      label="[telemetry off, no pallas]")
+        _, r_tel, c_tel, _ = measure(
+            cfg.replace(telemetry=args.telemetry, use_pallas=False),
+            label=f"[telemetry {args.telemetry}]")
+        telemetry_out = {
+            "level": args.telemetry,
+            "off_rounds_per_sec": round(r_base, 4),
+            "on_rounds_per_sec": round(r_tel, 4),
+            "overhead_pct": round(100.0 * (1.0 - r_tel / r_base), 2),
+            "compile_s": round(c_tel, 1),
+        }
+        log(f"[bench] telemetry={args.telemetry} overhead: "
+            f"{telemetry_out['overhead_pct']}%")
+
     # performance anatomy (VERDICT r2 weak #1): FLOPs/round from XLA's own
     # cost analysis of the compiled client step, and MFU against the chip's
     # bf16 peak — "actually fast, or just correct?" on the record
@@ -448,6 +504,7 @@ def main():
     # async metrics drain removes from the round loop's critical path
     # (eval_sync_s - eval_dispatch_s = host wait the driver no longer pays)
     host_sync = None
+    hb.update(phase="eval_probe", force=True)
     try:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
             make_eval_fn, pad_eval_set)
@@ -519,6 +576,11 @@ def main():
         out["mfu"] = round(mfu, 4)
     if faults_out is not None:
         out["faults"] = faults_out
+    if telemetry_out is not None:
+        out["telemetry"] = telemetry_out
+    # per-phase span aggregates (obs/spans.py): where this bench's wall
+    # time actually went — probe vs data vs acquire vs blocks
+    out["spans"] = tracer.aggregates()
     if cpu_fallback:
         # rounds are 10x smaller than the TPU config: value is NOT
         # comparable to TPU rows, vs_baseline (per-batch-normalized) is
@@ -527,6 +589,7 @@ def main():
         out["synth_override"] = args.synth_train_size
     if backend_note:
         out["backend_note"] = backend_note
+    hb.close("done")
     print(json.dumps(out))
 
 

@@ -23,7 +23,7 @@ from typing import Tuple
 import numpy as np
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (
-    Stamp, build_stamp, apply_stamp)
+    build_stamp, apply_stamp)
 
 
 def select_poison_idxs(labels: np.ndarray, base_class: int, frac: float,

@@ -123,6 +123,25 @@ class Config:
                                     # loop); --sync_metrics opts out.
                                     # Diagnostics/debug_nan/multi-process
                                     # runs are always synchronous.
+    # --- observability (obs/) ---
+    telemetry: str = "off"          # off | basic | full — in-jit defense
+                                    # telemetry (obs/telemetry.py): norm
+                                    # percentiles + RLR flip fraction
+                                    # (basic), + vote-margin histogram and
+                                    # honest/corrupt cosine split (full).
+                                    # off adds NOTHING to the traced
+                                    # program: training is bit-identical.
+    spans: bool = True              # host-side round-trace spans
+                                    # (obs/spans.py): trace.json in the run
+                                    # dir + Spans/* aggregates in
+                                    # metrics.jsonl; --no_spans opts out
+    heartbeat: bool = True          # atomically-rewritten status.json
+                                    # (obs/heartbeat.py) for the session
+                                    # stall detectors; --no_heartbeat
+    status_file: str = ""           # heartbeat path ("" = <log_dir>/
+                                    # status.json — a stable path the
+                                    # watchers can find without knowing
+                                    # the run name)
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -324,6 +343,22 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--compile_cache_dir", type=str, default=d.compile_cache_dir,
                    help="compile-cache root (default: $RLR_COMPILE_CACHE_DIR "
                         "or ~/.cache/rlr_fl)")
+    p.add_argument("--telemetry", choices=("off", "basic", "full"),
+                   default=d.telemetry,
+                   help="in-jit defense telemetry (obs/telemetry.py): "
+                        "basic = update-norm percentiles + RLR flip "
+                        "fraction; full adds the vote-margin histogram "
+                        "and honest/corrupt cosine split. Scalars stay "
+                        "on device and ride the async metrics drain; "
+                        "off is bit-identical to a build without it")
+    p.add_argument("--no_spans", action="store_true",
+                   help="disable the host-side round-trace spans "
+                        "(obs/spans.py: trace.json + Spans/* aggregates)")
+    p.add_argument("--no_heartbeat", action="store_true",
+                   help="disable the status.json heartbeat "
+                        "(obs/heartbeat.py)")
+    p.add_argument("--status_file", type=str, default=d.status_file,
+                   help="heartbeat path (default <log_dir>/status.json)")
     p.add_argument("--sync_metrics", action="store_true",
                    help="force the synchronous metrics path (float() host "
                         "sync every eval boundary) instead of the async "
@@ -366,6 +401,8 @@ def args_parser(argv: Optional[list] = None) -> Config:
     kw["tensorboard"] = not ns.no_tensorboard
     kw["compile_cache"] = not ns.no_compile_cache
     kw["async_metrics"] = not ns.sync_metrics
+    kw["spans"] = not ns.no_spans
+    kw["heartbeat"] = not ns.no_heartbeat
     return Config(**kw)
 
 

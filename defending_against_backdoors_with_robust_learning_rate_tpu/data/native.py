@@ -23,7 +23,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 

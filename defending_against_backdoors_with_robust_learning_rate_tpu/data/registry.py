@@ -24,7 +24,7 @@ import gzip
 import os
 import pickle
 import struct
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

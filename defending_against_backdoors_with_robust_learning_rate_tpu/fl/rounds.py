@@ -20,7 +20,6 @@ Two data modes:
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,10 +40,22 @@ def _pallas_applicable(cfg) -> bool:
     [+ RLR], no server noise) paths — the paper's headline configurations.
     Diagnostics need the explicit lr tree, which the fused kernel never
     materializes; the faults path needs the participation mask threaded
-    through the vote, which the fused kernel does not take."""
+    through the vote, which the fused kernel does not take; defense
+    telemetry (obs/telemetry.py) likewise needs the explicit lr/aggregate
+    trees, so any --telemetry level falls back to the jnp path."""
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
             and cfg.noise == 0 and not cfg.diagnostics
-            and not cfg.faults_enabled)
+            and not cfg.faults_enabled and cfg.telemetry == "off")
+
+
+def host_takes_flags(cfg) -> bool:
+    """Whether the host-sampled per-round step takes the trailing [m] bool
+    corrupt-slot flags argument: the faults path needs them for
+    --faults_spare_corrupt participation, and full telemetry for the
+    honest-vs-corrupt cosine split. Single source for the driver, the AOT
+    aval planner (utils/compile_cache.plan_programs) and the step
+    builders — their signatures must agree."""
+    return cfg.faults_enabled or cfg.telemetry == "full"
 
 
 def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
@@ -114,9 +125,10 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                                     corrupt_flags)
         if cfg.straggler_rate > 0:
             ep_budget = draw.ep_budget
-    updates, losses = vmap_agents(local_train, params, imgs, lbls, sizes,
-                                  agent_keys, cfg.agent_chunk,
-                                  ep_budget=ep_budget)
+    with jax.named_scope("local_train"):
+        updates, losses = vmap_agents(local_train, params, imgs, lbls, sizes,
+                                      agent_keys, cfg.agent_chunk,
+                                      ep_budget=ep_budget)
     mask = None
     extras = {}
     if draw is not None:
@@ -136,17 +148,24 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
             float(cfg.robustLR_threshold), cfg.effective_server_lr,
             interpret=jax.default_backend() != "tpu", mode=cfg.aggr)
         return new_params, jnp.mean(losses), {}
-    if cfg.robustLR_threshold > 0:
-        thr = (masking.rlr_threshold(cfg, mask) if mask is not None
-               else float(cfg.robustLR_threshold))
-        lr = robust_lr(updates, thr, cfg.effective_server_lr, mask=mask)
-    else:
-        lr = cfg.effective_server_lr
-    agg = aggregate_updates(updates, sizes, cfg, k_noise, mask=mask)
-    if mask is not None:
-        # all payloads dropped/rejected -> zero aggregate, no-op round
-        agg = masking.guard_empty(agg, mask)
-    new_params = apply_aggregate(params, lr, agg)
+    with jax.named_scope("aggregate_rlr"):
+        if cfg.robustLR_threshold > 0:
+            thr = (masking.rlr_threshold(cfg, mask) if mask is not None
+                   else float(cfg.robustLR_threshold))
+            lr = robust_lr(updates, thr, cfg.effective_server_lr, mask=mask)
+        else:
+            lr = cfg.effective_server_lr
+        agg = aggregate_updates(updates, sizes, cfg, k_noise, mask=mask)
+        if mask is not None:
+            # all payloads dropped/rejected -> zero aggregate, no-op round
+            agg = masking.guard_empty(agg, mask)
+        new_params = apply_aggregate(params, lr, agg)
+    if cfg.telemetry != "off":
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            telemetry)
+        extras.update(telemetry.compute(
+            cfg, updates, lr if cfg.robustLR_threshold > 0 else None, agg,
+            mask=mask, corrupt_flags=corrupt_flags))
     if cfg.diagnostics:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
             per_agent_norms)
@@ -178,6 +197,10 @@ def make_chained(step, data, family: str = "chained"):
             out = {"train_loss": info["train_loss"],
                    "sampled": info["sampled"]}
             out.update({k: info[k] for k in FAULT_INFO_KEYS if k in info})
+            # telemetry scalars (obs/telemetry.py) ride the scan stacked
+            # per-round, like the fault counters
+            out.update({k: v for k, v in info.items()
+                        if k.startswith("tel_")})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short
@@ -212,15 +235,20 @@ def _make_sample_step(cfg, model, normalize):
 
     def step(params, key, images, labels, sizes):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
-        sampled = jax.random.permutation(k_sample, K)[:m]
-        imgs = jnp.take(images, sampled, axis=0)
-        lbls = jnp.take(labels, sampled, axis=0)
-        szs = jnp.take(sizes, sampled, axis=0)
+        with jax.named_scope("sample_gather"):
+            sampled = jax.random.permutation(k_sample, K)[:m]
+            imgs = jnp.take(images, sampled, axis=0)
+            lbls = jnp.take(labels, sampled, axis=0)
+            szs = jnp.take(sizes, sampled, axis=0)
+        # faults need the corrupt-slot flags for participation; full
+        # telemetry needs them for the honest/corrupt cosine split
+        # (host_takes_flags is the single source of that condition)
+        want_flags = host_takes_flags(cfg)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
             local_train=local_train, cfg=cfg,
             corrupt_flags=(sampled < cfg.num_corrupt
-                           if cfg.faults_enabled else None))
+                           if want_flags else None))
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
@@ -266,17 +294,24 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
                         (images, labels, sizes))
 
 
-def make_host_step(cfg, model, normalize):
+def make_host_step(cfg, model, normalize, take_flags=None):
     """Unjitted host-sampled step(params, key, imgs, lbls, sizes) — the
     shared body of the per-round and chained host fns (key split into
     k_train/k_noise matches bit-for-bit between them).
 
-    With faults configured the step takes a sixth argument: the [m] bool
-    `corrupt_flags` for the sampled slots (the driver computes it from the
-    host-sampled ids — in-jit sampling isn't available to derive it here)."""
+    With faults — or full telemetry — configured the step takes a sixth
+    argument: the [m] bool `corrupt_flags` for the sampled slots (the
+    driver computes it from the host-sampled ids — in-jit sampling isn't
+    available to derive it here; single source: `host_takes_flags`).
+    `take_flags=False` forces the flag-free signature: the chained host
+    scan has no per-round flag channel, so it degrades the telemetry
+    cosine split to all-honest instead of changing its calling
+    convention."""
     local_train = make_local_train(model, cfg, normalize)
+    if take_flags is None:
+        take_flags = host_takes_flags(cfg)
 
-    if cfg.faults_enabled:
+    if take_flags:
         def step(params, key, imgs, lbls, sizes, corrupt_flags):
             k_train, k_noise = jax.random.split(key)
             new_params, train_loss, extras = _round_core(
@@ -324,6 +359,8 @@ def make_chained_host(step):
                 params, jax.random.fold_in(base_key, rnd), im, lb, sz)
             out = {"train_loss": info["train_loss"]}
             out.update({k: info[k] for k in FAULT_INFO_KEYS if k in info})
+            out.update({k: v for k, v in info.items()
+                        if k.startswith("tel_")})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short chains
@@ -337,6 +374,10 @@ def make_chained_host(step):
 def make_chained_round_fn_host(cfg, model, normalize):
     """Chained host-sampled rounds: chained(params, base_key, round_ids,
     imgs, lbls, sizes) with [chain, m, ...] blocks (diagnostics unsupported;
-    the driver runs diagnostic snap rounds unchained)."""
+    the driver runs diagnostic snap rounds unchained). take_flags=False:
+    the scan carries no per-round corrupt flags (under faults the driver
+    disables host chaining entirely; under full telemetry the cosine
+    split degrades to all-honest)."""
     return make_chained_host(
-        make_host_step(cfg.replace(diagnostics=False), model, normalize))
+        make_host_step(cfg.replace(diagnostics=False), model, normalize,
+                       take_flags=False))

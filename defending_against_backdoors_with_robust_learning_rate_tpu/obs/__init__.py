@@ -1,0 +1,25 @@
+"""Observability subsystem: round-trace spans, in-jit defense telemetry,
+and the structured run heartbeat.
+
+Three layers, built to be cheap enough to leave on:
+
+- `obs.spans`      host-side span tracer emitting Chrome-trace/Perfetto
+                   `trace.json` plus matching `jax.profiler` annotations;
+                   per-span p50/p95/max aggregates land in metrics.jsonl
+                   (`Spans/*`) and the bench JSON.
+- `obs.telemetry`  defense telemetry computed INSIDE the jitted round fn
+                   (vote-margin histogram, lr flip fraction, update-norm
+                   percentiles, honest-vs-corrupt cosine) — device-resident
+                   scalars that ride the async MetricsDrain, gated by
+                   `--telemetry off|basic|full`. `off` leaves the traced
+                   program untouched: training is bit-identical.
+- `obs.heartbeat`  an atomically-rewritten `status.json` (phase, round,
+                   last span, compile-in-flight flag, PID) that
+                   `scripts/tpu_watch.sh` and the session stall detector
+                   consume instead of parsing stderr growth.
+"""
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs.heartbeat import (  # noqa: F401
+    Heartbeat, NullHeartbeat, is_stale, read_status)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs.spans import (  # noqa: F401
+    SpanTracer)

@@ -1,0 +1,167 @@
+"""Zero-dependency host-side span tracer.
+
+The driver's time goes into phases nobody could attribute without
+hand-running `scripts/profile_round.py`: host gather, dispatch, eval,
+drain waits, checkpoint writes. A `SpanTracer` wraps each phase in a
+`with tracer.span("round/dispatch"):` block and produces
+
+- a Chrome-trace / Perfetto `trace.json` (the `traceEvents` "X" complete-
+  event schema — open it at https://ui.perfetto.dev or chrome://tracing),
+- per-span aggregates (count, total, p50/p95/max milliseconds) for
+  metrics.jsonl (`Spans/<name>/p50_ms`, ...) and the bench JSON,
+- matching `jax.profiler.TraceAnnotation` annotations, so when a device
+  trace is being captured (`--profile_dir`) the host spans line up with
+  the XLA timeline and device time can be attributed to the same names.
+
+Thread-safe: spans may open/close on the metrics-drain thread (the
+`metrics/emit` span) concurrently with the round loop's spans; each
+thread gets its own trace `tid`, and nesting depth is tracked per thread.
+A disabled tracer's `span()` is a no-op context manager (one attribute
+check, no locks), so the tracer can be threaded unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+# growth bound: a multi-day run must not accumulate events without limit.
+# Past the cap, events are dropped (counted) but aggregates keep updating —
+# percentile summaries stay honest while the trace covers the run's head.
+MAX_EVENTS = 200_000
+# per-name duration reservoir for the percentile aggregates; past the cap
+# new durations still update count/total/max but stop entering the sample
+MAX_DURATIONS_PER_NAME = 50_000
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted non-empty list."""
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+class SpanTracer:
+    def __init__(self, enabled: bool = True, clock=time.perf_counter,
+                 annotate: bool = True, on_end=None):
+        """`clock` is injectable for exactness tests; `annotate` wires the
+        matching `jax.profiler.TraceAnnotation` (skipped when jax is
+        unavailable — the tracer itself is zero-dep); `on_end(name, dur_s)`
+        is an optional completion hook (the heartbeat's last-span field)."""
+        self.enabled = enabled
+        self._clock = clock
+        self._on_end = on_end
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._durations: Dict[str, List[float]] = {}
+        self._totals: Dict[str, List[float]] = {}  # name -> [count, total, max]
+        self._local = threading.local()
+        self._t0 = clock()
+        self._annotation = None
+        if annotate:
+            try:
+                import jax.profiler
+                self._annotation = jax.profiler.TraceAnnotation
+            except Exception:
+                self._annotation = None
+
+    # --- recording -------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, **args):
+        if not self.enabled:
+            yield
+            return
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        annotation = self._annotation(name) if self._annotation else None
+        if annotation is not None:
+            annotation.__enter__()
+        start = self._clock()
+        try:
+            yield
+        finally:
+            dur = self._clock() - start
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
+            self._local.depth = depth
+            self._record(name, start, dur, depth, args)
+            if self._on_end is not None:
+                try:
+                    self._on_end(name, dur)
+                except Exception:
+                    pass  # observability must never take down the run
+
+    def _record(self, name: str, start: float, dur: float, depth: int,
+                args: Dict[str, Any]) -> None:
+        with self._lock:
+            agg = self._totals.setdefault(name, [0, 0.0, 0.0])
+            agg[0] += 1
+            agg[1] += dur
+            agg[2] = max(agg[2], dur)
+            sample = self._durations.setdefault(name, [])
+            if len(sample) < MAX_DURATIONS_PER_NAME:
+                sample.append(dur)
+            if len(self._events) >= MAX_EVENTS:
+                self._dropped += 1
+                return
+            ev = {"name": name, "ph": "X", "cat": "host",
+                  "ts": round((start - self._t0) * 1e6, 3),
+                  "dur": round(dur * 1e6, 3),
+                  "pid": os.getpid(),
+                  "tid": threading.get_ident() & 0x7FFFFFFF,
+                  "args": {"depth": depth, **args}}
+            self._events.append(ev)
+
+    # --- reporting -------------------------------------------------------
+    def aggregates(self) -> Dict[str, Dict[str, float]]:
+        """{name: {count, total_s, p50_ms, p95_ms, max_ms}} per span type."""
+        with self._lock:
+            out = {}
+            for name, (count, total, mx) in sorted(self._totals.items()):
+                sample = sorted(self._durations.get(name, ()))
+                out[name] = {
+                    "count": count,
+                    "total_s": round(total, 4),
+                    "p50_ms": round(_percentile(sample, 0.50) * 1e3, 3)
+                    if sample else 0.0,
+                    "p95_ms": round(_percentile(sample, 0.95) * 1e3, 3)
+                    if sample else 0.0,
+                    "max_ms": round(mx * 1e3, 3),
+                }
+            return out
+
+    def span_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._totals)
+
+    def write_trace(self, path: str) -> Optional[str]:
+        """Write the Chrome-trace JSON (atomic: tmp + rename). Returns the
+        path, or None when disabled / nothing recorded."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        if not events:
+            return None
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"tracer": "rlr_fl.obs.spans",
+                             "dropped_events": dropped}}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def scalar_rows(self):
+        """Flat (tag, value) rows for metrics.jsonl: Spans/<name>/<stat>."""
+        rows = []
+        for name, agg in self.aggregates().items():
+            for stat in ("count", "total_s", "p50_ms", "p95_ms", "max_ms"):
+                rows.append((f"Spans/{name}/{stat}", float(agg[stat])))
+        return rows

@@ -23,7 +23,6 @@ single TPU chip, a v5e-8 slice, and a multi-host pod.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import numpy as np

@@ -255,18 +255,23 @@ def _sharded_pallas_apply(params, updates, sizes, cfg):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
-def _build_sharded_body(cfg, model, normalize, mesh):
+def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
     """The shard_mapped round body shared by the per-round and chained fns.
 
-    With faults configured the body takes a trailing replicated [m] bool
-    `corrupt_flags` input: every device derives the IDENTICAL fault draw
-    from the replicated fault key (faults/model.py — no collective needed
-    to agree on who failed), slices its local block of the draw by mesh
-    position, and the only added communication is one tiny all_gather of
-    the per-device payload-validation bits."""
+    With faults — or full telemetry — configured the body takes a trailing
+    replicated [m] bool `corrupt_flags` input (`take_flags`; single source
+    fl/rounds.host_takes_flags, overridable to False for the chained host
+    scan, which has no per-round flag channel). Under faults every device
+    derives the IDENTICAL fault draw from the replicated fault key
+    (faults/model.py — no collective needed to agree on who failed),
+    slices its local block of the draw by mesh position, and the only
+    added communication is one tiny all_gather of the per-device
+    payload-validation bits."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-        _pallas_applicable)
+        _pallas_applicable, host_takes_flags)
     faults_on = cfg.faults_enabled
+    if take_flags is None:
+        take_flags = host_takes_flags(cfg)
     if faults_on:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
             model as fmodel)
@@ -277,11 +282,12 @@ def _build_sharded_body(cfg, model, normalize, mesh):
     mb = m // d
 
     def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
+        corrupt_full = rest[0] if take_flags else None
         mask_local = mask_full = draw = ep_local = None
         if faults_on:
             # replicated draw: every device computes the same [m] pattern
             draw = fmodel.sample_faults(cfg, fmodel.fault_key(noise_key), m,
-                                        rest[0])
+                                        corrupt_full)
             pos = jax.lax.axis_index(AGENTS_AXIS) * mb
 
             def local(v):
@@ -289,9 +295,10 @@ def _build_sharded_body(cfg, model, normalize, mesh):
             if cfg.straggler_rate > 0:
                 ep_local = local(draw.ep_budget)
         # chunking applies to the per-device agent block (m/d agents)
-        updates, losses = vmap_agents(local_train, params, imgs, lbls, szs,
-                                      keys, cfg.agent_chunk,
-                                      ep_budget=ep_local)
+        with jax.named_scope("local_train"):
+            updates, losses = vmap_agents(local_train, params, imgs, lbls,
+                                          szs, keys, cfg.agent_chunk,
+                                          ep_budget=ep_local)
         if faults_on:
             from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
                 masking)
@@ -307,17 +314,26 @@ def _build_sharded_body(cfg, model, normalize, mesh):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
             return new_params, loss, {}
-        if cfg.robustLR_threshold > 0:
-            lr = _sharded_robust_lr(updates, cfg, mask_local, mask_full)
-        else:
-            lr = cfg.effective_server_lr
-        agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
-                                 mask_local, mask_full)
-        new_params = apply_aggregate(params, lr, agg)
+        with jax.named_scope("aggregate_rlr"):
+            if cfg.robustLR_threshold > 0:
+                lr = _sharded_robust_lr(updates, cfg, mask_local, mask_full)
+            else:
+                lr = cfg.effective_server_lr
+            agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
+                                     mask_local, mask_full)
+            new_params = apply_aggregate(params, lr, agg)
         loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
         extras = {}
         if faults_on:
             extras.update(fmodel.fault_scalars(draw, mask_full))
+        if cfg.telemetry != "off":
+            from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                telemetry)
+            extras.update(telemetry.compute_sharded(
+                cfg, updates,
+                lr if cfg.robustLR_threshold > 0 else None, agg,
+                AGENTS_AXIS, mask_local=mask_local, mask_full=mask_full,
+                corrupt_full=corrupt_full))
         if cfg.diagnostics:
             from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
                 per_agent_norms)
@@ -333,13 +349,17 @@ def _build_sharded_body(cfg, model, normalize, mesh):
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
             FAULT_INFO_KEYS)
         extras_specs.update({k: P() for k in FAULT_INFO_KEYS})
+    if cfg.telemetry != "off":
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs.telemetry import (
+            telemetry_keys)
+        extras_specs.update({k: P() for k in telemetry_keys(cfg)})
     if cfg.diagnostics:
         extras_specs["agent_norms"] = P()
         if cfg.robustLR_threshold > 0:
             extras_specs["lr_flat"] = P()
 
     in_specs = (P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
-                P(AGENTS_AXIS), P()) + ((P(),) if faults_on else ())
+                P(AGENTS_AXIS), P()) + ((P(),) if take_flags else ())
     return shard_map(
         shard_body, mesh=mesh,
         in_specs=in_specs,
@@ -357,17 +377,21 @@ def _make_sample_step(cfg, model, normalize, mesh):
     stays bit-identical to per-round dispatch. The dataset stacks are jit
     ARGUMENTS, not closure captures (closure arrays get inlined into the
     lowered HLO as dense constants — see fl/rounds._make_sample_step)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        host_takes_flags)
     sharded = _build_sharded_body(cfg, model, normalize, mesh)
     K, m = cfg.num_agents, cfg.agents_per_round
+    want_flags = host_takes_flags(cfg)
 
     def step(params, key, images, labels, sizes):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
-        sampled = jax.random.permutation(k_sample, K)[:m]
-        imgs = jnp.take(images, sampled, axis=0)
-        lbls = jnp.take(labels, sampled, axis=0)
-        szs = jnp.take(sizes, sampled, axis=0)
+        with jax.named_scope("sample_gather"):
+            sampled = jax.random.permutation(k_sample, K)[:m]
+            imgs = jnp.take(images, sampled, axis=0)
+            lbls = jnp.take(labels, sampled, axis=0)
+            szs = jnp.take(sizes, sampled, axis=0)
         agent_keys = jax.random.split(k_train, m)
-        extra = ((sampled < cfg.num_corrupt,) if cfg.faults_enabled else ())
+        extra = ((sampled < cfg.num_corrupt,) if want_flags else ())
         new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
                                                  agent_keys, k_noise, *extra)
         return new_params, {"train_loss": train_loss, "sampled": sampled,
@@ -390,18 +414,24 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
                              else "round_sharded"))
 
 
-def make_sharded_host_step(cfg, model, normalize, mesh):
+def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):
     """Unjitted sharded host step(params, key, imgs, lbls, sizes) — shared
     body of the per-round and chained sharded host fns. Key derivation
     (split into k_train/k_noise, then m agent keys) matches
     fl/rounds.make_host_step bit-for-bit, so the sharded and single-device
     host paths are comparable round-for-round."""
-    sharded = _build_sharded_body(cfg, model, normalize, mesh)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        host_takes_flags)
+    if take_flags is None:
+        take_flags = host_takes_flags(cfg)
+    sharded = _build_sharded_body(cfg, model, normalize, mesh,
+                                  take_flags=take_flags)
     m = cfg.agents_per_round
 
-    if cfg.faults_enabled:
-        # faults: the driver passes the sampled slots' corrupt flags (it
-        # owns the host-side id sampling) — see fl/rounds.make_host_step
+    if take_flags:
+        # faults / full telemetry: the driver passes the sampled slots'
+        # corrupt flags (it owns the host-side id sampling) — see
+        # fl/rounds.make_host_step
         def step(params, key, imgs, lbls, szs, corrupt_flags):
             k_train, k_noise = jax.random.split(key)
             agent_keys = jax.random.split(k_train, m)
@@ -440,7 +470,7 @@ def make_sharded_chained_round_fn_host(cfg, model, normalize, mesh):
         make_chained_host)
     return make_chained_host(
         make_sharded_host_step(cfg.replace(diagnostics=False), model,
-                               normalize, mesh))
+                               normalize, mesh, take_flags=False))
 
 
 def make_sharded_chained_round_fn(cfg, model, normalize, mesh,

@@ -11,7 +11,7 @@ gap, and BASELINE.json's headline metric)."""
 
 from __future__ import annotations
 
-import math
+import os
 import time
 from typing import Dict, Optional
 
@@ -28,7 +28,9 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
     make_eval_fn, pad_eval_set)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-    FAULT_INFO_KEYS, make_round_fn, make_round_fn_host)
+    FAULT_INFO_KEYS, host_takes_flags, make_round_fn, make_round_fn_host)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    Heartbeat, NullHeartbeat, SpanTracer, telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -115,9 +117,22 @@ def apply_rng_impl(choice: str) -> str:
 
 def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     print_exp_details(cfg)
+    obs_telemetry.check_level(cfg.telemetry)
     impl = apply_rng_impl(cfg.rng_impl)
     if impl != "threefry2x32":
         print(f"[rng] {impl} bit generator")
+    # observability (obs/): host-side round-trace spans + the status.json
+    # heartbeat, lead process only. The heartbeat rides the tracer's
+    # span-completion hook, so `last_span` tracks without extra calls.
+    lead = jax.process_index() == 0
+    hb = (Heartbeat(cfg.status_file
+                    or os.path.join(cfg.log_dir, "status.json"))
+          if cfg.heartbeat and lead else NullHeartbeat())
+    tracer = SpanTracer(enabled=cfg.spans and lead, on_end=hb.span_hook)
+    hb.update(phase="setup", rounds=cfg.rounds, force=True)
+    if cfg.telemetry != "off":
+        print(f"[telemetry] in-jit defense telemetry: {cfg.telemetry} "
+              f"(Defense/* scalars ride the metrics stream)")
     # persistent XLA cache + AOT executable bank — must be configured
     # before the first compile so every program family persists
     bank = compile_cache.setup(cfg)
@@ -295,15 +310,19 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
 
         def gather_unit(unit):
             """One dispatch unit's payload: a single round's [m, ...] stacks
-            or a chained block's [chain, m, ...] stacks (one placement)."""
-            ids = np.stack([sample_ids(r) for r in unit])
-            if len(unit) == 1:
-                return (ids[0], take(fed.train.images, ids[0]),
-                        take(fed.train.labels, ids[0]),
-                        take(fed.train.sizes, ids[0]))
-            return (ids, take_block(fed.train.images, ids),
-                    take_block(fed.train.labels, ids),
-                    take_block(fed.train.sizes, ids))
+            or a chained block's [chain, m, ...] stacks (one placement).
+            The span lands on whichever thread runs the gather — the
+            prefetch worker in pipelined mode, so trace.json shows the
+            overlap."""
+            with tracer.span("prefetch/gather", rounds=len(unit)):
+                ids = np.stack([sample_ids(r) for r in unit])
+                if len(unit) == 1:
+                    return (ids[0], take(fed.train.images, ids[0]),
+                            take(fed.train.labels, ids[0]),
+                            take(fed.train.sizes, ids[0]))
+                return (ids, take_block(fed.train.images, ids),
+                        take_block(fed.train.labels, ids),
+                        take_block(fed.train.sizes, ids))
 
         # host gather + H2D transfer overlap the running round program
         # (data/prefetch.py); created lazily at the first dispatch so a
@@ -328,15 +347,20 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             return gather_unit(unit)
 
         def host_sampler(params, key, rnd, want_diag):
-            ids, imgs, lbls, szs = get_unit((rnd,))
+            with tracer.span("round/data_prep", round=rnd):
+                ids, imgs, lbls, szs = get_unit((rnd,))
             fn = diag_round_fn_host if want_diag else round_fn_host
-            if cfg.faults_enabled:
-                # faults: the host-sampled ids determine which slots hold
-                # malicious agents (--faults_spare_corrupt participation)
-                flags = jnp.asarray(ids < cfg.num_corrupt)
-                new_params, info = fn(params, key, imgs, lbls, szs, flags)
-            else:
-                new_params, info = fn(params, key, imgs, lbls, szs)
+            with tracer.span("round/dispatch", round=rnd):
+                if host_takes_flags(cfg):
+                    # faults: the host-sampled ids determine which slots
+                    # hold malicious agents (--faults_spare_corrupt
+                    # participation); full telemetry: the honest/corrupt
+                    # cosine split needs the same flags
+                    flags = jnp.asarray(ids < cfg.num_corrupt)
+                    new_params, info = fn(params, key, imgs, lbls, szs,
+                                          flags)
+                else:
+                    new_params, info = fn(params, key, imgs, lbls, szs)
             info["sampled"] = ids
             return new_params, info
     else:
@@ -415,7 +439,6 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     pval = tuple(map(jnp.asarray, pad_eval_set(
         fed.pval_images, fed.pval_labels, cfg.eval_bs)))
 
-    lead = jax.process_index() == 0
     if writer is None:
         writer = (MetricsWriter(cfg.log_dir, run_name(cfg), cfg.tensorboard)
                   if lead else NullWriter())
@@ -446,6 +469,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     # warm-starts through the persistent XLA cache. Any per-family failure
     # also falls back to jit.
     eval_val_fn = eval_pval_fn = eval_fn
+    # the stall detectors must not kill a first-time compile (the
+    # documented tunnel-wedge cause): flag the compile window until the
+    # first dispatch unit has executed
+    hb.update(phase="compile", compile_in_flight=True, force=True)
     if bank is not None and jax.process_count() == 1 and n_mesh == 1:
         ab = compile_cache.abstractify
         p_aval, k_aval = ab(params), ab(base_key)
@@ -457,7 +484,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 for a in (fed.train.images, fed.train.labels,
                           fed.train.sizes))
             flag_avals = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
-                          if cfg.faults_enabled else ())
+                          if host_takes_flags(cfg) else ())
             shared = diag_round_fn_host is round_fn_host
             fn = _adopt_aot(bank, cfg, "round_host", round_fn_host,
                             (p_aval, k_aval) + shard_avals + flag_avals)
@@ -518,7 +545,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     # values; multi-process jobs keep the lead-only writer synchronous.
     use_async = (cfg.async_metrics and not cfg.debug_nan
                  and not cfg.diagnostics and jax.process_count() == 1)
-    drain = MetricsDrain() if use_async else None
+    drain = MetricsDrain(tracer=tracer) if use_async else None
     if drain is not None:
         print("[metrics] async drain: host syncs ride a background thread "
               "(--sync_metrics restores the inline path)")
@@ -536,6 +563,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         bit-identical between the modes (tests/test_async_metrics.py).
         The cumulative poison mean accumulates HERE in host float64,
         matching the synchronous semantics exactly."""
+        with tracer.span("metrics/emit", round=ernd):
+            _emit_eval_body(vals, ernd, rounds_done_now, elapsed)
+
+    def _emit_eval_body(vals, ernd, rounds_done_now, elapsed):
         finite_warn(vals["finite"], where=f"round {ernd}",
                     raise_error=cfg.debug_nan)
         val_loss = float(vals["val_loss"])
@@ -562,6 +593,9 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                           float(vals["fault_straggled"]), ernd)
             writer.scalar("Faults/Effective_Voters",
                           float(vals["fault_voters"]), ernd)
+        # Defense/* telemetry scalars (obs/telemetry.py), shared emit path
+        # so sync and async streams stay bit-identical
+        obs_telemetry.emit_scalars(writer, vals, ernd)
         writer.scalar("Throughput/Rounds_Per_Sec",
                       rounds_done_now / elapsed, ernd)
         now = time.perf_counter()
@@ -595,6 +629,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     t_loop = time.perf_counter()
     rounds_done = 0
     rnd = start_round
+    first_unit = True
     # ONE source of truth for chaining decisions: the loop consumes the
     # same schedule the host-mode prefetcher produces against, so the two
     # cannot desynchronize (code review r3)
@@ -606,34 +641,46 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     # it pins device arrays and would leak per failed run
     try:
         for unit in units:
+            hb.update(phase="train", round=unit[-1])
             if len(unit) > 1:
                 # chained block: fixed length => one compilation per shape
-                ids = jnp.arange(unit[0], unit[-1] + 1)
-                if chained_fn is not None:
-                    params, stacked = chained_fn(params, base_key, ids)
-                else:
-                    # host-sampled block: the prefetcher hands over the
-                    # whole [chain, m, ...] shard-stack payload at once
-                    _, imgs, lbls, szs = get_unit(unit)
-                    params, stacked = host_chained_fn(params, base_key, ids,
-                                                      imgs, lbls, szs)
+                with tracer.span("round/data_prep", round=unit[-1]):
+                    ids = jnp.arange(unit[0], unit[-1] + 1)
+                    payload = None if chained_fn is not None \
+                        else get_unit(unit)
+                with tracer.span("round/dispatch", round=unit[-1],
+                                 chain=len(unit)):
+                    if chained_fn is not None:
+                        params, stacked = chained_fn(params, base_key, ids)
+                    else:
+                        # host-sampled block: the prefetcher hands over the
+                        # whole [chain, m, ...] shard-stack payload at once
+                        _, imgs, lbls, szs = payload
+                        params, stacked = host_chained_fn(
+                            params, base_key, ids, imgs, lbls, szs)
                 rnd = unit[-1]
                 rounds_done += len(unit)
                 info = {"train_loss": stacked["train_loss"][-1]}
                 info.update({k: stacked[k][-1] for k in FAULT_INFO_KEYS
                              if k in stacked})
+                info.update({k: stacked[k][-1] for k in stacked
+                             if k.startswith("tel_")})
                 want_diag, prev_params = False, None
             else:
                 rnd = unit[0]
-                key = jax.random.fold_in(base_key, rnd)
-                snap_round = rnd % cfg.snap == 0
-                want_diag = cfg.diagnostics and snap_round
-                prev_params = params if want_diag else None
+                with tracer.span("round/data_prep", round=rnd):
+                    key = jax.random.fold_in(base_key, rnd)
+                    snap_round = rnd % cfg.snap == 0
+                    want_diag = cfg.diagnostics and snap_round
+                    prev_params = params if want_diag else None
                 if host_sampler is not None:
+                    # host_sampler opens its own data_prep/dispatch spans
+                    # (the gather is the interesting part there)
                     params, info = host_sampler(params, key, rnd, want_diag)
                 else:
-                    params, info = (diag_round_fn if want_diag else round_fn)(
-                        params, key)
+                    with tracer.span("round/dispatch", round=rnd):
+                        params, info = (diag_round_fn if want_diag
+                                        else round_fn)(params, key)
                 rounds_done += 1
 
             if want_diag:
@@ -659,6 +706,7 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                         writer.scalar(tag, v, rnd)
 
             if rnd % cfg.snap == 0:
+                hb.update(phase="eval", round=rnd)
                 # divergence aborts only under --debug_nan (sync mode);
                 # otherwise the finite check rides the drain and warns,
                 # and the run keeps recording its (NaN) metrics
@@ -666,9 +714,12 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 # eval dispatches on the (un-donated) params BEFORE the
                 # next dispatch unit runs: in async mode round r's eval
                 # executes overlapped with the round r+1 training block
-                val_loss_d, val_acc_d, per_class_d = eval_val_fn(params,
-                                                                 *val)
-                poison_loss_d, poison_acc_d, _ = eval_pval_fn(params, *pval)
+                with tracer.span("eval/val_dispatch", round=rnd):
+                    val_loss_d, val_acc_d, per_class_d = eval_val_fn(params,
+                                                                     *val)
+                with tracer.span("eval/poison_dispatch", round=rnd):
+                    poison_loss_d, poison_acc_d, _ = eval_pval_fn(params,
+                                                                  *pval)
                 vals.update(val_loss=val_loss_d, val_acc=val_acc_d,
                             base_acc=per_class_d[cfg.base_class],
                             poison_loss=poison_loss_d,
@@ -676,11 +727,15 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                             train_loss=info["train_loss"])
                 if "fault_voters" in info:
                     vals.update({k: info[k] for k in FAULT_INFO_KEYS})
+                # in-jit defense telemetry rides the same (async) fetch
+                vals.update({k: info[k] for k in info
+                             if k.startswith("tel_")})
                 if drain is not None:
                     elapsed = time.perf_counter() - t_loop
                     drain.submit(emit_eval, vals, rnd, rounds_done, elapsed)
                 else:
-                    vals = jax.device_get(vals)   # THE per-round host sync
+                    with tracer.span("metrics/host_sync", round=rnd):
+                        vals = jax.device_get(vals)  # THE per-round sync
                     elapsed = time.perf_counter() - t_loop
                     emit_eval(vals, rnd, rounds_done, elapsed)
                 # every process calls save: orbax runs cross-process barriers
@@ -690,16 +745,27 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                 # every eval boundary up to this round.
                 if cfg.checkpoint_dir:
                     if drain is not None:
-                        drain.flush()
-                    ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
-                              mstate["cum_poison_acc"], cum_net_mov)
+                        with tracer.span("drain/wait", round=rnd):
+                            drain.flush()
+                    hb.update(phase="checkpoint", round=rnd)
+                    with tracer.span("ckpt/save", round=rnd):
+                        ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
+                                  mstate["cum_poison_acc"], cum_net_mov)
+            if first_unit:
+                # every hot-path program has now traced+compiled (or
+                # loaded); from here a silent heartbeat means a stall,
+                # not XLA working
+                first_unit = False
+                hb.update(compile_in_flight=False, force=True)
             if drain is None:
                 writer.flush()
         # surface any drain-thread error while the run's state is intact
         # (the finally below closes without raising, to not mask a loop
         # exception with a secondary metrics error)
         if drain is not None:
-            drain.flush()
+            hb.update(phase="drain", force=True)
+            with tracer.span("drain/wait"):
+                drain.flush()
     finally:
         if drain is not None:
             drain.close(raise_errors=False)
@@ -724,7 +790,22 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
           f"({rounds_done} rounds in {elapsed:.1f}s)"
           + (f"; steady-state {summary['steady_rounds_per_sec']:.3f} r/s"
              if "steady_rounds_per_sec" in summary else ""))
+    # per-span aggregates -> metrics.jsonl (Spans/*) and the summary; the
+    # full event stream -> trace.json in the run dir (Perfetto-loadable)
+    if tracer.enabled:
+        for tag, v in tracer.scalar_rows():
+            writer.scalar(tag, v, rnd)
+        summary["spans"] = tracer.aggregates()
+        run_dir = getattr(writer, "dir", None)
+        if run_dir:
+            trace_path = tracer.write_trace(
+                os.path.join(run_dir, "trace.json"))
+            if trace_path:
+                summary["trace_path"] = trace_path
+                print(f"[spans] {trace_path} "
+                      f"(load in https://ui.perfetto.dev)")
     writer.close()
+    hb.close("done")
     return summary
 
 

@@ -64,6 +64,9 @@ EXCLUDED_FIELDS = frozenset({
     "data_dir", "log_dir", "checkpoint_dir", "resume", "profile_dir",
     "tensorboard", "rounds", "snap", "seed", "chain", "host_prefetch",
     "compile_cache", "compile_cache_dir", "async_metrics",
+    # obs/: spans + heartbeat are host-side IO; `telemetry` is NOT here —
+    # it adds outputs to the traced program, so it must key the cache
+    "spans", "heartbeat", "status_file",
 })
 
 # families built from cfg.replace(diagnostics=False) in the driver; their
@@ -354,8 +357,8 @@ def plan_programs(cfg, model, norm, fed,
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
         make_eval_fn, pad_eval_set)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-        make_chained_round_fn, make_chained_round_fn_host, make_round_fn,
-        make_round_fn_host)
+        host_takes_flags, make_chained_round_fn, make_chained_round_fn_host,
+        make_round_fn, make_round_fn_host)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         init_params)
 
@@ -378,7 +381,7 @@ def plan_programs(cfg, model, norm, fed,
             jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
             for a in data_avals)
         flags = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
-                 if cfg.faults_enabled else ())
+                 if host_takes_flags(cfg) else ())
         specs.append(ProgramSpec(
             "round_host", make_round_fn_host(plain, model, norm),
             (params_aval, key_aval) + shard_avals + flags))

@@ -31,8 +31,14 @@ def run_name(cfg) -> str:
     their metrics.jsonl streams can be diffed directly)."""
     faults = ""
     if cfg.faults_enabled:
+        # every fault knob that changes the experiment must be in the name:
+        # two sweep cells differing only in threshold mode / spare-corrupt
+        # used to collide into one run dir and interleave their
+        # metrics.jsonl streams
         faults = (f"-flt:d{cfg.dropout_rate}"
-                  f"s{cfg.straggler_rate}c{cfg.corrupt_rate}")
+                  f"s{cfg.straggler_rate}c{cfg.corrupt_rate}"
+                  f"-thrm:{cfg.rlr_threshold_mode}"
+                  + ("-spare" if cfg.faults_spare_corrupt else ""))
     return (f"clip_val:{cfg.clip}"
             f"-noise_std:{cfg.noise}-aggr:{cfg.aggr}"
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
@@ -66,7 +72,7 @@ class MetricsDrain:
     next flush()/close() on the submitting thread, and later submissions
     are dropped — metrics can lag, never corrupt silently."""
 
-    def __init__(self):
+    def __init__(self, tracer=None):
         self._items = collections.deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -75,6 +81,9 @@ class MetricsDrain:
         self._error = None
         self._dead = False      # drain thread exited on error: reject work
         self._thread = None
+        # optional obs.spans.SpanTracer: attributes the batched device_get
+        # (the host sync this pipeline hides) on the drain thread's track
+        self._tracer = tracer
 
     def submit(self, fn, device_vals, *host_args) -> None:
         """Queue fn(fetched_device_vals, *host_args) for the drain thread.
@@ -103,7 +112,12 @@ class MetricsDrain:
             try:
                 # ONE transfer for everything queued right now: the whole
                 # batch's device scalars come back in a single device_get
-                fetched = jax.device_get([d for _, d, _ in batch])
+                if self._tracer is not None:
+                    with self._tracer.span("drain/device_get",
+                                           batch=len(batch)):
+                        fetched = jax.device_get([d for _, d, _ in batch])
+                else:
+                    fetched = jax.device_get([d for _, d, _ in batch])
                 for (fn, _, host_args), vals in zip(batch, fetched):
                     fn(vals, *host_args)
             except BaseException as e:  # noqa: BLE001 — re-raised at flush

@@ -15,10 +15,8 @@ Writes BASELINE_MEASURED.json at the repo root.
 
 import json
 import os
-import sys
 import time
 
-import numpy as np
 import torch
 
 

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Faults sweep driver — the ROADMAP open item, as one command.
+
+Maps the poison-accuracy cliff under churn: sweeps
+``--dropout_rate x --rlr_threshold_mode {abs,scaled}`` with
+``--faults_spare_corrupt`` (attackers never drop out — the adversarial
+participation model that thins the RLR defense's honest majority) on the
+fmnist flagship attack+defense config (bench.py's bench_config — the
+paper's FMNIST setting: 1 corrupt agent, poison_frac 0.5, RLR threshold 4).
+
+One JSONL row per cell, appended and flushed as each cell finishes (a
+killed sweep keeps every completed row):
+
+    {"dropout_rate": 0.3, "rlr_threshold_mode": "scaled",
+     "faults_spare_corrupt": true, "rounds": 200, "seed": 0,
+     "val_acc": ..., "poison_acc": ..., "rounds_per_sec": ..., ...}
+
+Telemetry (obs/telemetry.py) defaults to `basic` here — the sweep is
+exactly the experiment the Defense/* scalars exist for; each cell's run
+dir gets its own metrics.jsonl + trace.json (the run_name includes the
+threshold mode and spare flag, so cells never collide).
+
+    python scripts/sweep_faults.py                     # full ladder
+    python scripts/sweep_faults.py --dropout_rates 0,0.3 --rounds 50
+
+The masking *overhead* companion number comes from `bench.py --faults`
+(recorded in the session's BENCH_*.json), not from this driver — sweep
+rows measure defense outcomes, the bench measures cost.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SUMMARY_KEYS = ("round", "val_acc", "val_loss", "poison_acc", "poison_loss",
+                "rounds_per_sec", "steady_rounds_per_sec")
+
+
+def sweep_cells(dropout_rates, modes):
+    """One (dropout, mode) cell per distinct experiment. At dropout 0 the
+    faults path is off entirely (Config.faults_enabled), so the threshold
+    mode cannot matter — emit a single baseline cell instead of one
+    bit-identical run per mode (which would also collide into one run dir:
+    run_name only carries the mode inside the faults suffix)."""
+    cells = []
+    for d in dropout_rates:
+        for m in modes:
+            cells.append((d, m))
+            if d == 0:
+                break
+    return cells
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dropout_rates", default="0,0.1,0.2,0.3,0.4,0.5",
+                    help="comma list of per-round client dropout rates")
+    ap.add_argument("--modes", default="abs,scaled",
+                    help="comma list of rlr_threshold_mode values")
+    ap.add_argument("--rounds", type=int, default=200,
+                    help="FL rounds per cell (flagship default)")
+    ap.add_argument("--snap", type=int, default=10,
+                    help="eval cadence within each cell")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no_spare_corrupt", action="store_true",
+                    help="let attackers drop out too (default: "
+                         "--faults_spare_corrupt adversarial participation)")
+    ap.add_argument("--telemetry", choices=("off", "basic", "full"),
+                    default="basic",
+                    help="in-jit defense telemetry level per cell")
+    ap.add_argument("--out", default="sweep_faults.jsonl",
+                    help="output JSONL (one row per cell, appended)")
+    ap.add_argument("--log_dir", default="./logs",
+                    help="per-cell run dirs land under here")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (cpu|tpu); empty = default")
+    ap.add_argument("--synth_train_size", type=int, default=0,
+                    help="override the synthetic dataset size (forces the "
+                         "synthetic generator; CI-scale smoke); 0 = "
+                         "flagship default")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from bench import bench_config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        run)
+
+    rates = [float(x) for x in args.dropout_rates.split(",") if x != ""]
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    spare = not args.no_spare_corrupt
+    cells = sweep_cells(rates, modes)
+    print(f"[sweep] {len(cells)} cells: dropout {rates} x mode {modes} "
+          f"(spare_corrupt={spare}) -> {args.out}")
+
+    base = bench_config("fmnist").replace(
+        rounds=args.rounds, snap=args.snap, seed=args.seed,
+        telemetry=args.telemetry, log_dir=args.log_dir, tensorboard=False)
+    if args.synth_train_size:
+        base = base.replace(
+            synth_train_size=args.synth_train_size,
+            synth_val_size=max(64, args.synth_train_size // 10),
+            data_dir="/nonexistent_use_synthetic_reduced")
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    done = 0
+    with open(args.out, "a") as out:
+        for dropout, mode in cells:
+            cfg = base.replace(dropout_rate=dropout,
+                               rlr_threshold_mode=mode,
+                               faults_spare_corrupt=spare)
+            t0 = time.perf_counter()
+            print(f"[sweep] cell dropout={dropout} mode={mode} ...")
+            summary = run(cfg)
+            row = {"dropout_rate": dropout, "rlr_threshold_mode": mode,
+                   "faults_spare_corrupt": spare, "rounds": args.rounds,
+                   "seed": args.seed, "cell_s": round(
+                       time.perf_counter() - t0, 1)}
+            row.update({k: summary[k] for k in SUMMARY_KEYS
+                        if k in summary})
+            # flush per row: a killed sweep keeps every completed cell
+            out.write(json.dumps(row) + "\n")
+            out.flush()
+            done += 1
+            print(f"[sweep] {done}/{len(cells)} done: "
+                  f"poison_acc={row.get('poison_acc')} "
+                  f"val_acc={row.get('val_acc')}")
+    print(f"[sweep] complete: {done} rows appended to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,35 +44,57 @@ SUCCESSES=0
 # `[bench] compile+first` line (the round-block compile — the dominant
 # first-time compile — is finished by then; with a warm executable bank
 # it appears in seconds). After that a STALL deadline applies: kill only
-# after 1800s with zero stderr growth. Growth resets the clock, so the
-# smaller post-measurement compiles (cost-analysis jit, eval probe, the
-# --faults re-measures — each of which logs lines around it) keep the
-# process alive while it is making progress; only a genuinely hung
-# process is reaped, and never before the main compile has landed.
+# after 1800s with zero progress. Progress is read from the STRUCTURED
+# heartbeat bench.py now writes (obs/heartbeat.py: logs/status.json,
+# atomically rewritten with phase + compile_in_flight) with stderr growth
+# kept as a fallback signal; a status.json reporting compile_in_flight
+# resets the clock outright — killing mid-compile is the documented
+# tunnel-wedge cause, so the detector is patient exactly then.
+STATUS=logs/status.json
+status_mtime() { stat -c %Y "$STATUS" 2>/dev/null || echo 0; }
+# exit 0 only for a FRESH compile-in-flight heartbeat: the compile budget
+# is bounded (obs/heartbeat.py DEFAULT_COMPILE_STALE_S) — a process wedged
+# mid-compile with a frozen status.json must still be reaped eventually,
+# just on the patient clock, not the 1800s one
+status_compiling() {
+    python - "$STATUS" 2>/dev/null <<'PY'
+import json, sys, time
+try:
+    s = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+fresh = time.time() - float(s.get("updated_at", 0)) < 3600
+sys.exit(0 if s.get("compile_in_flight") and fresh else 1)
+PY
+}
 run_bench() {
     local out=$1; shift
     local err="${out%.txt}.err"
     : >"$err"
     python bench.py "$@" >"$out" 2>"$err" &
     local pid=$!
-    local armed=0 stalled=0 size=0 newsize=0
+    local armed=0 stalled=0 size=0 newsize=0 hb=0 newhb=0
     while kill -0 "$pid" 2>/dev/null; do
         sleep 15
         if [ "$armed" -eq 0 ] && grep -q "compile+first" "$err"; then
             armed=1
             stalled=0
             size=$(wc -c <"$err")
+            hb=$(status_mtime)
         fi
         if [ "$armed" -eq 1 ]; then
             newsize=$(wc -c <"$err")
-            if [ "$newsize" -ne "$size" ]; then
+            newhb=$(status_mtime)
+            if [ "$newsize" -ne "$size" ] || [ "$newhb" -ne "$hb" ] \
+                    || status_compiling; then
                 size=$newsize
+                hb=$newhb
                 stalled=0
             else
                 stalled=$((stalled + 15))
             fi
             if [ "$stalled" -ge 1800 ]; then
-                say "WARN: bench stalled 1800s post-compile — killing $pid"
+                say "WARN: bench stalled 1800s post-compile (no heartbeat, no stderr growth) — killing $pid"
                 kill "$pid" 2>/dev/null
             fi
         fi
@@ -90,14 +112,14 @@ if ! timeout 60 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1; then
 fi
 say "TPU alive"
 
-say "step 0/5: precompile + bank all flagship program families (watchdog-free window)"
+say "step 0/6: precompile + bank all flagship program families (watchdog-free window)"
 if python scripts/precompile.py >>"$LOG" 2>&1; then
     say "precompile done — later steps load banked executables"
 else
     say "WARN: precompile rc=$? — steps fall back to jit compiles"
 fi
 
-say "step 1/5: flagship TPU bench (re-land the r3 number; VERDICT next #2)"
+say "step 1/6: flagship TPU bench (re-land the r3 number; VERDICT next #2)"
 if run_bench logs/bench_r5_stdout.txt; then
     tail -1 logs/bench_r5_stdout.txt > BENCH_TPU_r05.json
     say "bench: $(cat BENCH_TPU_r05.json)"
@@ -106,7 +128,7 @@ else
     say "WARN: bench rc=$? — see $LOG"
 fi
 
-say "step 2/5: sweep close-out (probe ladders -> decisions -> all row families -> seeds -> trace -> figures)"
+say "step 2/6: sweep close-out (probe ladders -> decisions -> all row families -> seeds -> trace -> figures)"
 if bash scripts/sweep_close_out.sh logs >>"$LOG" 2>&1; then
     say "close-out done"
     SUCCESSES=$((SUCCESSES + 1))
@@ -114,7 +136,7 @@ else
     say "WARN: close-out rc=$?"
 fi
 
-say "step 3/5: ResNet-9 bf16 bench + selective-remat A/B (VERDICT next #4)"
+say "step 3/6: ResNet-9 bf16 bench + selective-remat A/B (VERDICT next #4)"
 if run_bench logs/bench_resnet9_bf16.txt --bench_config resnet9 --dtype bf16; then
     say "resnet9 bf16 baseline: $(tail -1 logs/bench_resnet9_bf16.txt)"
     SUCCESSES=$((SUCCESSES + 1))
@@ -141,7 +163,32 @@ for AB in "conv -1" "none -1" "none 20" "none 0"; do
     fi
 done
 
-say "step 4/5: figures refresh"
+say "step 4/6: faults masking-overhead + telemetry-overhead bench (bench --faults --telemetry full)"
+# ROADMAP faults axis: the masking-overhead fields (`faults` in the JSON)
+# plus the obs/telemetry.py overhead A/B, one bench invocation; the
+# flagship program family is long-banked so this is measurement, not
+# compile risk
+if run_bench logs/bench_r5_faults.txt --faults --telemetry full; then
+    tail -1 logs/bench_r5_faults.txt > BENCH_TPU_r05_faults.json
+    say "faults/telemetry bench: $(cat BENCH_TPU_r05_faults.json)"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: faults/telemetry bench rc=$?"
+fi
+
+say "step 5/6: faults sweep (poison-accuracy cliff under churn -> sweep_faults.jsonl)"
+# dropout x rlr_threshold_mode with --faults_spare_corrupt on the fmnist
+# flagship config (scripts/sweep_faults.py); one JSONL row per cell,
+# flushed as cells land, so a mid-sweep kill keeps completed rows
+if python scripts/sweep_faults.py --rounds 100 --snap 10 \
+        --out sweep_faults.jsonl >>"$LOG" 2>&1; then
+    say "faults sweep done: $(wc -l < sweep_faults.jsonl) rows"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: faults sweep rc=$?"
+fi
+
+say "step 6/6: figures refresh"
 # NOT counted in SUCCESSES: plot_curves re-renders from a pre-existing
 # results.json, so it succeeds even when every measurement step failed —
 # it must not keep the lock held over a zero-measurement session
@@ -156,7 +203,8 @@ python scripts/plot_curves.py >>"$LOG" 2>&1 || say "WARN: plot failed"
 # and the commit to them (unrelated pre-staged work in this checkout is
 # neither swept in nor sole trigger)
 PRESENT=""
-for f in BENCH_TPU_r05.json results.json RESULTS.md performance.png \
+for f in BENCH_TPU_r05.json BENCH_TPU_r05_faults.json sweep_faults.jsonl \
+         results.json RESULTS.md performance.png \
          poison_acc.png BENCH_NOTES.md; do
     [ -e "$f" ] && git add -- "$f" 2>>"$LOG" && PRESENT="$PRESENT $f"
 done

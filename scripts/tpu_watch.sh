@@ -4,12 +4,43 @@
 # different session script as $1). Writes progress to logs/tpu_watch.log.
 # Start with:
 #   nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 &
+#
+# Liveness is read from the STRUCTURED heartbeat first (obs/heartbeat.py:
+# logs/status.json — phase, pid, compile_in_flight, updated_at): if a live
+# run already owns the chip, the watcher defers instead of racing it with
+# a probe. Only when no heartbeat is fresh does it fall back to the
+# jax.devices() probe.
 cd "$(dirname "$0")/.."
 SESSION=${1:-scripts/tpu_session_r5.sh}
+STATUS=logs/status.json
 mkdir -p logs
 W=logs/tpu_watch.log
 [ -f "$SESSION" ] || { echo "[watcher] session script $SESSION missing — refusing to burn the TPU-alive trigger on a no-op" >>"$W"; exit 1; }
+
+# exit 0 when status.json reports a live run: pid alive and heartbeat
+# fresh (compile windows get the larger budget — a compiling run is quiet
+# by design and must not be probed over)
+status_live() {
+    [ -f "$STATUS" ] || return 1
+    python - "$STATUS" 2>/dev/null <<'PY'
+import json, os, sys, time
+try:
+    s = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+age = time.time() - float(s.get("updated_at", 0))
+budget = 3600 if s.get("compile_in_flight") else 600
+alive = os.path.exists("/proc/%d" % int(s.get("pid", 0)))
+sys.exit(0 if alive and age < budget else 1)
+PY
+}
+
 for i in $(seq 1 70); do
+  if status_live; then
+    echo "[watcher] probe $i: live heartbeat in $STATUS at $(date) — an active run owns the TPU; deferring" >>"$W"
+    sleep 520
+    continue
+  fi
   if timeout 45 python -c "import jax; jax.devices()" >>"$W" 2>&1; then
     echo "[watcher] TPU alive at $(date); launching $SESSION" >>"$W"
     bash "$SESSION" >>"$W" 2>&1

@@ -9,7 +9,6 @@ import torch
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
     clip_updates, make_fisher_fn, norm_scalars, per_agent_norms,
     sign_agreement)
-from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 
 
 def test_clip_updates_bounds_each_agent():

@@ -1,0 +1,451 @@
+"""Observability subsystem (obs/): spans, in-jit defense telemetry, and
+the structured heartbeat — plus their driver integration (ISSUE 3
+acceptance: trace.json with >=5 span types, Defense/* + Spans/* scalars
+in metrics.jsonl, status.json heartbeat, and --telemetry off bit-identity
+with a build that never computes telemetry)."""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import Config
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    Heartbeat, SpanTracer, heartbeat as hb_mod, telemetry)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs.spans import (
+    _percentile)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- spans ---------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_span_nesting_and_exactness(tmp_path):
+    clock = FakeClock()
+    tr = SpanTracer(clock=clock, annotate=False)
+    with tr.span("outer"):
+        clock.t += 1.0
+        with tr.span("inner"):
+            clock.t += 0.25
+        clock.t += 0.5
+    agg = tr.aggregates()
+    assert agg["inner"]["count"] == 1 and agg["outer"]["count"] == 1
+    # exact durations through the injected clock
+    assert agg["inner"]["total_s"] == pytest.approx(0.25)
+    assert agg["outer"]["total_s"] == pytest.approx(1.75)
+    path = tr.write_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    ev = {e["name"]: e for e in doc["traceEvents"]}
+    # chrome-trace schema: complete events with microsecond ts/dur; the
+    # inner span nests inside the outer on the same tid
+    for e in ev.values():
+        assert e["ph"] == "X" and {"name", "ts", "dur", "pid",
+                                   "tid"} <= set(e)
+    assert ev["inner"]["tid"] == ev["outer"]["tid"]
+    assert ev["inner"]["dur"] == pytest.approx(0.25e6)
+    assert ev["outer"]["dur"] == pytest.approx(1.75e6)
+    assert ev["outer"]["ts"] <= ev["inner"]["ts"]
+    assert (ev["inner"]["ts"] + ev["inner"]["dur"]
+            <= ev["outer"]["ts"] + ev["outer"]["dur"] + 1e-6)
+    assert ev["inner"]["args"]["depth"] == 1
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_span_aggregates_percentiles():
+    clock = FakeClock()
+    tr = SpanTracer(clock=clock, annotate=False)
+    for ms in range(1, 101):          # 1..100 ms spans
+        with tr.span("x"):
+            clock.t += ms / 1e3
+    agg = tr.aggregates()["x"]
+    assert agg["count"] == 100
+    assert agg["p50_ms"] == pytest.approx(51.0)
+    assert agg["p95_ms"] == pytest.approx(96.0)
+    assert agg["max_ms"] == pytest.approx(100.0)
+    # nearest-rank helper is total-order sane
+    assert _percentile([1.0], 0.95) == 1.0
+    rows = dict(tr.scalar_rows())
+    assert rows["Spans/x/count"] == 100.0
+    assert rows["Spans/x/max_ms"] == pytest.approx(100.0)
+
+
+def test_disabled_tracer_is_noop(tmp_path):
+    tr = SpanTracer(enabled=False)
+    with tr.span("never"):
+        pass
+    assert tr.aggregates() == {} and tr.span_names() == []
+    assert tr.write_trace(str(tmp_path / "t.json")) is None
+    assert not (tmp_path / "t.json").exists()
+
+
+def test_span_tracer_thread_safety():
+    tr = SpanTracer(annotate=False)
+
+    def work():
+        for _ in range(200):
+            with tr.span("t"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.aggregates()["t"]["count"] == 800
+
+
+# --- heartbeat -----------------------------------------------------------
+
+def test_heartbeat_atomic_under_concurrent_reads(tmp_path):
+    path = str(tmp_path / "status.json")
+    hb = Heartbeat(path, min_interval_s=0.0)
+    stop = threading.Event()
+    failures = []
+
+    def reader():
+        while not stop.is_set():
+            s = hb_mod.read_status(path)
+            if s is None or "phase" not in s or "pid" not in s:
+                failures.append(s)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(300):
+        hb.update(phase=f"p{i % 7}", round=i, force=True)
+    stop.set()
+    t.join()
+    # os.replace is atomic: a reader never observes a partial/missing file
+    assert failures == []
+    final = hb_mod.read_status(path)
+    assert final["phase"] == "exited" or final["round"] == 299
+    hb.close()
+    assert hb_mod.read_status(path)["phase"] == "exited"
+
+
+def test_heartbeat_rate_limit_and_phase_change(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "s.json")
+    hb = Heartbeat(path, min_interval_s=10.0, clock=clock)
+    hb.update(round=1)                     # within interval: no write
+    assert hb_mod.read_status(path)["round"] == 0
+    hb.update(phase="train", round=2)      # phase change: writes
+    assert hb_mod.read_status(path)["round"] == 2
+    hb.update(round=3)                     # rate-limited again
+    assert hb_mod.read_status(path)["round"] == 2
+    clock.t += 11.0
+    hb.update(round=4)                     # interval elapsed
+    assert hb_mod.read_status(path)["round"] == 4
+
+
+def test_heartbeat_stall_detector_semantics():
+    now = 1000.0
+    assert hb_mod.is_stale(None, now)
+    fresh = {"updated_at": now - 10.0, "compile_in_flight": False}
+    assert not hb_mod.is_stale(fresh, now)
+    quiet = {"updated_at": now - 600.0, "compile_in_flight": False}
+    assert hb_mod.is_stale(quiet, now)
+    # the same silence during a compile is NOT a stall (killing
+    # mid-compile is the documented tunnel-wedge cause)
+    compiling = {"updated_at": now - 600.0, "compile_in_flight": True}
+    assert not hb_mod.is_stale(compiling, now)
+    assert hb_mod.is_stale({"updated_at": now - 4000.0,
+                            "compile_in_flight": True}, now)
+
+
+# --- telemetry: pure math ------------------------------------------------
+
+def _cfg(**kw):
+    kw.setdefault("telemetry", "full")
+    return Config(data="synthetic", num_agents=8, **kw)
+
+
+def test_telemetry_cosine_separates_honest_from_corrupt():
+    m, k = 8, 16
+    rng = np.random.default_rng(0)
+    direction = rng.normal(size=(k,)).astype(np.float32)
+    honest = direction[None, :] + 0.05 * rng.normal(size=(m, k))
+    updates = {"w": jnp.asarray(honest, jnp.float32)}
+    corrupt_flags = jnp.asarray([True, True] + [False] * (m - 2))
+    # corrupt agents push the OPPOSITE direction
+    updates["w"] = updates["w"].at[:2].set(-updates["w"][:2])
+    agg = {"w": jnp.mean(updates["w"], axis=0)}
+    out = jax.jit(lambda u, a, c: telemetry.compute(
+        _cfg(), u, None, a, corrupt_flags=c))(updates, agg, corrupt_flags)
+    assert float(out["tel_cos_honest"]) > 0.5
+    assert float(out["tel_cos_corrupt"]) < 0.0
+    assert -1.0 - 1e-5 <= float(out["tel_cos_corrupt"])
+    assert float(out["tel_cos_honest"]) <= 1.0 + 1e-5
+    # margin histogram is a distribution over all coordinates
+    hist = np.asarray(out["tel_margin_hist"])
+    assert hist.shape == (telemetry.N_MARGIN_BUCKETS,)
+    assert np.isclose(hist.sum(), 1.0)
+    assert 0.0 <= float(out["tel_margin_mean"]) <= 1.0
+    # norm percentiles are ordered
+    assert (float(out["tel_upd_norm_p50"])
+            <= float(out["tel_upd_norm_p95"])
+            <= float(out["tel_upd_norm_max"]))
+
+
+def test_telemetry_flip_fraction_counts_negative_lr():
+    lr = {"a": jnp.asarray([1.0, -1.0, -1.0, 1.0]),
+          "b": jnp.asarray([[1.0, -1.0]])}
+    updates = {"a": jnp.zeros((4, 4)), "b": jnp.zeros((4, 1, 2))}
+    agg = {"a": jnp.zeros((4,)), "b": jnp.zeros((1, 2))}
+    cfg = _cfg(robustLR_threshold=4, telemetry="basic")
+    out = telemetry.compute(cfg, updates, lr, agg)
+    assert float(out["tel_flip_frac"]) == pytest.approx(3.0 / 6.0)
+
+
+def test_telemetry_keys_match_levels():
+    assert telemetry.telemetry_keys(_cfg(telemetry="off")) == ()
+    basic = telemetry.telemetry_keys(_cfg(telemetry="basic",
+                                          robustLR_threshold=4))
+    assert "tel_flip_frac" in basic and "tel_margin_hist" not in basic
+    full = set(telemetry.telemetry_keys(_cfg()))
+    assert {"tel_margin_hist", "tel_cos_honest",
+            "tel_cos_corrupt"} <= full
+    with pytest.raises(ValueError, match="telemetry"):
+        telemetry.check_level("verbose")
+
+
+def test_telemetry_sharded_matches_vmap():
+    """compute_sharded under shard_map over the 8-device CPU mesh must
+    reproduce compute's scalars (same math through psum/all_gather)."""
+    from jax.sharding import PartitionSpec as P
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.compat import (
+        shard_map)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+        AGENTS_AXIS, make_mesh)
+
+    m, k = 8, 12
+    rng = np.random.default_rng(1)
+    updates = {"w": jnp.asarray(rng.normal(size=(m, k)), jnp.float32)}
+    agg = {"w": jnp.mean(updates["w"], axis=0)}
+    flags = jnp.asarray([True] + [False] * (m - 1))
+    cfg = _cfg()
+    ref = telemetry.compute(cfg, updates, None, agg, corrupt_flags=flags)
+
+    mesh = make_mesh(8)
+    f = shard_map(
+        lambda u, a, c: telemetry.compute_sharded(
+            cfg, u, None, a, AGENTS_AXIS, corrupt_full=c),
+        mesh=mesh, in_specs=(P(AGENTS_AXIS), P(), P()),
+        out_specs={key: P() for key in telemetry.telemetry_keys(cfg)},
+        check_vma=False)
+    sharded = f(updates, agg, flags)
+    for key in ref:
+        np.testing.assert_allclose(np.asarray(sharded[key]),
+                                   np.asarray(ref[key]), rtol=1e-5,
+                                   atol=1e-6, err_msg=key)
+
+
+# --- telemetry: round-fn bit-identity ------------------------------------
+
+def test_telemetry_off_params_bit_identical_to_full():
+    """--telemetry off must leave the round program untouched; and since
+    telemetry only ADDS outputs, even `full` must not change the params
+    math — both pins in one: off/full final params bit-equal, and only
+    full emits tel_* keys."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+
+    cfg = Config(data="synthetic", num_agents=4, bs=16, local_ep=1,
+                 synth_train_size=64, synth_val_size=32,
+                 num_corrupt=1, poison_frac=1.0, robustLR_threshold=3)
+    fed = get_federated_data(cfg)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = tuple(map(jnp.asarray, (fed.train.images, fed.train.labels,
+                                     fed.train.sizes)))
+    params = init_params(model, fed.train.images.shape[2:],
+                         jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(3)
+    p_off, info_off = make_round_fn(cfg, model, norm, *arrays)(params, key)
+    p_full, info_full = make_round_fn(cfg.replace(telemetry="full"), model,
+                                      norm, *arrays)(params, key)
+    assert not any(k.startswith("tel_") for k in info_off)
+    assert any(k.startswith("tel_") for k in info_full)
+    for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                    jax.tree_util.tree_leaves(p_full)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- driver integration --------------------------------------------------
+
+SMOKE = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
+               synth_train_size=256, synth_val_size=64, eval_bs=64,
+               rounds=2, snap=1, seed=5, tensorboard=False,
+               num_corrupt=2, poison_frac=1.0, robustLR_threshold=3)
+
+
+def _tags(jsonl_path):
+    with open(jsonl_path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_driver_smoke_full_observability(tmp_path):
+    """The ISSUE-3 acceptance run: --telemetry full produces a
+    Perfetto-loadable trace.json with >=5 distinct span types, Defense/*
+    and Spans/* scalars in metrics.jsonl, and a status.json heartbeat."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu import train
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        MetricsWriter, run_name)
+
+    cfg = SMOKE.replace(telemetry="full", log_dir=str(tmp_path / "logs"),
+                        compile_cache_dir=str(tmp_path / "cache"))
+    writer = MetricsWriter(cfg.log_dir, run_name(cfg), tensorboard=False)
+    summary = train.run(cfg, writer=writer)
+
+    run_dir = writer.dir
+    records = _tags(os.path.join(run_dir, "metrics.jsonl"))
+    tags = {r["tag"] for r in records}
+    defense = {t for t in tags if t.startswith("Defense/")}
+    assert {"Defense/Update_Norm_P50", "Defense/LR_Flip_Fraction",
+            "Defense/Vote_Margin_Mean",
+            "Defense/Cosine_Honest_To_Agg"} <= defense
+    assert sum(1 for t in defense if "Vote_Margin_Hist" in t) \
+        == telemetry.N_MARGIN_BUCKETS
+    assert any(t.startswith("Spans/") for t in tags)
+    # margin-hist rows at one boundary sum to 1 (a distribution)
+    hist = [r["value"] for r in records
+            if r["tag"].startswith("Defense/Vote_Margin_Hist/")
+            and r["step"] == 2]
+    assert np.isclose(sum(hist), 1.0)
+
+    doc = json.load(open(os.path.join(run_dir, "trace.json")))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert len(names) >= 5, names
+    assert {"round/dispatch", "eval/val_dispatch",
+            "eval/poison_dispatch", "metrics/emit"} <= names
+    assert summary["spans"]["round/dispatch"]["count"] == cfg.rounds
+
+    status = json.load(open(os.path.join(cfg.log_dir, "status.json")))
+    assert status["phase"] == "done"
+    assert status["pid"] == os.getpid()
+    assert status["compile_in_flight"] is False
+    assert status["round"] == cfg.rounds
+
+
+def test_driver_telemetry_sync_async_defense_parity(tmp_path):
+    """Defense/* scalars ride the MetricsDrain: the async stream must be
+    bit-identical to --sync_metrics for every Defense record."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu import train
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        MetricsWriter, run_name)
+
+    base = SMOKE.replace(telemetry="basic",
+                         compile_cache_dir=str(tmp_path / "cache"))
+
+    def records(mode_dir, **kw):
+        cfg = base.replace(log_dir=str(tmp_path / mode_dir), **kw)
+        writer = MetricsWriter(cfg.log_dir, run_name(cfg),
+                               tensorboard=False)
+        train.run(cfg, writer=writer)
+        return [r for r in _tags(os.path.join(writer.dir, "metrics.jsonl"))
+                if r["tag"].startswith("Defense/")]
+
+    ra = records("async")
+    rs = records("sync", async_metrics=False)
+    assert ra == rs and len(ra) >= 2 * 4  # >=4 Defense rows per boundary
+
+
+def test_run_name_distinguishes_fault_sweep_cells():
+    """Satellite: two sweep cells differing only in rlr_threshold_mode or
+    faults_spare_corrupt must land in different run dirs."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        run_name)
+
+    base = Config(dropout_rate=0.3)
+    names = {run_name(base),
+             run_name(base.replace(rlr_threshold_mode="scaled")),
+             run_name(base.replace(faults_spare_corrupt=True)),
+             run_name(base.replace(rlr_threshold_mode="scaled",
+                                   faults_spare_corrupt=True))}
+    assert len(names) == 4
+    # and the faultless name is unchanged by the fault-only fields
+    assert run_name(Config()) == run_name(
+        Config(rlr_threshold_mode="scaled", faults_spare_corrupt=True))
+
+
+def _load_sweep_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "sweep_faults", os.path.join(ROOT, "scripts", "sweep_faults.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_faults_rows_and_cells(tmp_path, monkeypatch):
+    """scripts/sweep_faults.py: one JSONL row per cell with the sweep axes
+    and the outcome scalars, crash-safe append. train.run is stubbed so
+    tier-1 tests the driver logic, not another flagship compile (the real
+    1-cell run is the slow-tier test below)."""
+    mod = _load_sweep_module()
+    # dropout=0 disables the faults path entirely, so the threshold mode
+    # cannot matter there: a single baseline cell, not one per mode
+    assert mod.sweep_cells([0.0, 0.3], ["abs", "scaled"]) == [
+        (0.0, "abs"), (0.3, "abs"), (0.3, "scaled")]
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu import train
+    seen = []
+
+    def fake_run(cfg):
+        seen.append(cfg)
+        return {"round": cfg.rounds, "val_acc": 0.9, "val_loss": 0.3,
+                "poison_acc": 0.1, "poison_loss": 2.0,
+                "rounds_per_sec": 5.0}
+
+    monkeypatch.setattr(train, "run", fake_run)
+    out = tmp_path / "sweep.jsonl"
+    rc = mod.main([
+        "--dropout_rates", "0,0.3", "--modes", "scaled", "--rounds", "2",
+        "--out", str(out), "--log_dir", str(tmp_path / "logs")])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(out)]
+    assert len(rows) == 2 and len(seen) == 2
+    assert [r["dropout_rate"] for r in rows] == [0.0, 0.3]
+    for row, cfg in zip(rows, seen):
+        assert row["rlr_threshold_mode"] == "scaled"
+        assert row["faults_spare_corrupt"] is True
+        assert {"val_acc", "poison_acc", "rounds_per_sec"} <= set(row)
+        assert cfg.faults_spare_corrupt and cfg.rlr_threshold_mode == "scaled"
+    # distinct cells land in distinct run dirs (the run_name satellite)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        run_name)
+    assert run_name(seen[1]) != run_name(seen[0])
+
+
+@pytest.mark.slow  # one real flagship-shaped cell (~50s CPU compile);
+# the sweep driver logic is covered by the stubbed tier-1 test above
+def test_sweep_faults_driver_e2e(tmp_path):
+    mod = _load_sweep_module()
+    out = tmp_path / "sweep.jsonl"
+    rc = mod.main([
+        "--dropout_rates", "0.3", "--modes", "scaled", "--rounds", "1",
+        "--snap", "1", "--synth_train_size", "256", "--telemetry", "off",
+        "--out", str(out), "--log_dir", str(tmp_path / "logs")])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(out)]
+    assert len(rows) == 1
+    assert rows[0]["dropout_rate"] == 0.3
+    assert {"val_acc", "poison_acc", "rounds_per_sec"} <= set(rows[0])
