@@ -1,0 +1,9 @@
+"""JAX-aware static analysis: AST lint, jaxpr contracts, fingerprint audit.
+
+CLI: ``python -m defending_against_backdoors_with_robust_learning_rate_tpu.analysis``
+(CI wrapper: ``scripts/check_static.py``). See analysis/contracts.py for
+the declared budgets/allowlists and README "Static analysis" for usage.
+"""
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.ast_rules import (  # noqa: F401
+    Finding, scan, scan_repo)

@@ -1,0 +1,116 @@
+"""CLI for the static-analysis passes.
+
+    python -m defending_against_backdoors_with_robust_learning_rate_tpu.analysis
+        [--rules ast,audit,jaxpr] [--sharded] [--compiled]
+        [--write-baseline] [--no-baseline-check] [--json]
+        [--force-host-devices N] [--platform cpu]
+
+Exit codes: 0 clean, 1 findings, 2 internal error (a pass crashed — that
+is a bug in the pass or an unbuildable program family, not a lint hit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def repo_root() -> str:
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg_dir)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analysis",
+        description="JAX-aware static analysis: AST rules, jaxpr "
+                    "contracts, fingerprint audit")
+    ap.add_argument("--rules", default="ast,audit,jaxpr",
+                    help="comma subset of ast|audit|jaxpr")
+    ap.add_argument("--sharded", action="store_true",
+                    help="also check the shard_map program families "
+                         "(needs >1 devices dividing agents_per_round)")
+    ap.add_argument("--compiled", action="store_true",
+                    help="additionally compile checked families and "
+                         "assert post-optimization HLO collective "
+                         "ceilings (the CSE claims)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record the measured per-family counts into "
+                         "analysis_baseline.json instead of failing on "
+                         "drift")
+    ap.add_argument("--no-baseline-check", action="store_true",
+                    help="skip the exact-count comparison against "
+                         "analysis_baseline.json")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform for the jaxpr pass "
+                         "(cpu|tpu); empty = default")
+    ap.add_argument("--force-host-devices", type=int, default=0,
+                    help="fake N CPU devices via XLA_FLAGS (must run "
+                         "before jax initializes; use 8 for the CI mesh)")
+    args = ap.parse_args(argv)
+    rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+    unknown = rules - {"ast", "audit", "jaxpr"}
+    if unknown:
+        ap.error(f"unknown rules {sorted(unknown)}")
+
+    if args.force_host_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.force_host_devices}").strip()
+
+    root = repo_root()
+    findings = []
+    baseline = None
+    try:
+        if "ast" in rules:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+                ast_rules)
+            findings.extend(ast_rules.scan_repo(root))
+        if "audit" in rules:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+                fingerprint_audit)
+            findings.extend(fingerprint_audit.audit(root))
+        if "jaxpr" in rules:
+            if args.platform:
+                import jax
+                jax.config.update("jax_platforms", args.platform)
+            from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+                jaxpr_lint)
+            jfind, baseline = jaxpr_lint.run(sharded=args.sharded,
+                                             compiled=args.compiled)
+            findings.extend(jfind)
+            if args.write_baseline:
+                path = jaxpr_lint.write_baseline(root, baseline)
+                print(f"[analysis] baseline written: {path}",
+                      file=sys.stderr)
+            elif not args.no_baseline_check:
+                findings.extend(
+                    jaxpr_lint.compare_baseline(root, baseline))
+    except Exception as e:  # a crashed pass is exit 2, not a finding
+        print(f"[analysis] INTERNAL ERROR: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        import traceback
+        traceback.print_exc()
+        return 2
+
+    if args.as_json:
+        print(json.dumps([vars(f) for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f)
+        ran = ",".join(sorted(rules))
+        print(f"[analysis] {len(findings)} finding(s) "
+              f"({ran}{' +sharded' if args.sharded else ''}"
+              f"{' +compiled' if args.compiled else ''})",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Declared contracts for the static-analysis passes (analysis/).
+
+Three kinds of declaration live here, one per pass:
+
+1. **AST-rule scope + allowlist** (`analysis/ast_rules.py`): which modules
+   count as round/eval hot paths for the host-sync rule, which functions
+   are exempt from which rules (with the justification inline — an ALLOW
+   entry without a reason is a review defect), and which cross-module
+   callees donate their buffers.
+2. **Jaxpr contracts** (`analysis/jaxpr_lint.py`): the named check
+   configurations (tiny synthetic shapes — tracing cost, not training
+   cost) and the per-family collective budgets they must hold. Budgets
+   are ceilings derived from the implementation's documented communication
+   plan (parallel/rounds.py module docstring); `analysis_baseline.json`
+   records the exact measured counts so future PRs see *diffs*, not just
+   pass/fail.
+3. **Fingerprint provenance rules** (`analysis/fingerprint_audit.py`):
+   which provenance classes may/must appear in the AOT-bank fingerprint
+   (utils/compile_cache.EXCLUDED_FIELDS), and which package modules count
+   as program-shaping for the cfg-read cross-check.
+
+Adding a contract: append a `CheckSpec` to `check_specs()` (or widen a
+budget with a comment saying why the communication plan changed) and
+refresh `analysis_baseline.json` via
+`python -m defending_against_backdoors_with_robust_learning_rate_tpu.analysis --write-baseline`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+PKG = "defending_against_backdoors_with_robust_learning_rate_tpu"
+
+# --------------------------------------------------------------------------
+# AST-rule scope (analysis/ast_rules.py)
+# --------------------------------------------------------------------------
+
+# Modules whose code sits on the round/eval hot path: a host sync here
+# either blocks the dispatch loop (driver files) or is flat-out wrong
+# (traced files). Paths are repo-relative; trailing "/" means the subtree.
+HOT_PATH_MODULES = (
+    f"{PKG}/fl/",
+    f"{PKG}/ops/",
+    f"{PKG}/parallel/rounds.py",
+    f"{PKG}/faults/",
+    f"{PKG}/obs/telemetry.py",
+    f"{PKG}/data/prefetch.py",
+    f"{PKG}/train.py",
+    "scripts/profile_round.py",
+)
+
+# Function-level exemptions: (repo-relative path, function qualname prefix)
+# -> {rule: justification}. Nested functions inherit their parent's entry.
+# Every entry must say WHY the rule does not apply — these are the
+# documented escape hatches, not a dumping ground.
+ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
+    (f"{PKG}/train.py", "run._emit_eval_body"): {
+        "host-sync": "runs on the MetricsDrain thread (async mode) or at "
+                     "the eval boundary after an explicit device_get (sync "
+                     "mode); values are already host-side",
+    },
+    (f"{PKG}/obs/telemetry.py", "emit_scalars"): {
+        "host-sync": "host emit path shared by the sync/async metrics "
+                     "streams; called only with already-fetched values",
+    },
+    (f"{PKG}/fl/diagnostics.py", "norm_scalars"): {
+        "host-sync": "snap-cadence research diagnostics; --diagnostics "
+                     "forces the synchronous metrics path by design",
+    },
+    (f"{PKG}/fl/diagnostics.py", "sign_agreement"): {
+        "host-sync": "host-side set algebra on flat vectors at snap "
+                     "cadence (--diagnostics is synchronous by design)",
+    },
+    (f"{PKG}/ops/pallas_rlr.py", "_fused_leaf"): {
+        "host-sync": "float(threshold)/float(server_lr) convert Python "
+                     "config scalars into kernel kwargs at build time — "
+                     "no device value is touched",
+    },
+    (f"{PKG}/data/registry.py", "make_synthetic.gen"): {
+        "jit-side-effect": "host-side numpy dataset synthesis; `gen` is "
+                           "a data generator the builder calls eagerly, "
+                           "never traced (the make_ builder convention "
+                           "false-positives here)",
+    },
+    (f"{PKG}/ops/loops.py", "maybe_unrolled_scan"): {
+        "jit-side-effect": "RLR_SCAN_MODE/RLR_SCAN_UNROLL are deliberate "
+                           "trace-time measurement overrides (module "
+                           "docstring); NOTE they change the traced "
+                           "program without entering the AOT fingerprint "
+                           "— never set them outside profiling",
+    },
+}
+
+# Cross-module donated-buffer callees the donate-reuse rule tracks: callee
+# name -> donated positional-argument indices. In-module donation
+# (functools.partial(jax.jit, donate_argnums=...)) is detected
+# structurally; this covers names that cross a module boundary (train.py
+# calls the chained fns built in fl/rounds.py, which donate params).
+DONATED_CALLS: Dict[str, Tuple[int, ...]] = {
+    "chained_fn": (0,),
+    "host_chained_fn": (0,),
+}
+
+# --------------------------------------------------------------------------
+# Jaxpr contracts (analysis/jaxpr_lint.py)
+# --------------------------------------------------------------------------
+
+# primitives that must never appear in a round/eval program: host
+# callbacks stall the dispatch pipeline and are unserializable in the AOT
+# bank; infeed/outfeed are not part of this design at all.
+FORBIDDEN_PRIMITIVES = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "callback",
+    "outside_call", "infeed", "outfeed", "host_local_array_to_global_array",
+})
+
+# collective primitive names counted against the budgets
+COLLECTIVE_PRIMITIVES = ("psum", "all_gather", "all_to_all", "ppermute",
+                         "pmin", "pmax", "reduce_scatter")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckSpec:
+    """One jaxpr-contract check: a named tiny config, the program family
+    to trace, and the budgets its IR must hold.
+
+    `collective_budget` is a jaxpr-level ceiling per collective primitive
+    (traced eqn counts, pre-CSE — deterministic and compile-free).
+    `hlo_all_reduce_max` additionally bounds post-optimization all-reduce
+    ops in the compiled HLO (``--compiled`` mode): this is where the
+    "sign psums CSE with the RLR vote" claim becomes a test, because the
+    jaxpr-level count legitimately double-counts the shared vote."""
+    name: str
+    family: str
+    sharded: bool
+    cfg_overrides: Dict[str, object]
+    collective_budget: Dict[str, int]
+    hlo_all_reduce_max: Optional[int] = None
+    forbid_f64: bool = True
+    forbid_callbacks: bool = True
+    host_mode: bool = False    # plan the host-sampled variant (the driver
+                               # gathers shards host-side; [m, ...] args)
+
+
+def base_check_config():
+    """The tiny synthetic config every check derives from. 8 agents so the
+    8-device CI mesh gets 1 agent/device; shapes small enough that tracing
+    is milliseconds."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+        Config)
+    return Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
+                  synth_train_size=128, synth_val_size=32, eval_bs=32,
+                  rounds=2, snap=1, num_corrupt=2, poison_frac=0.5,
+                  robustLR_threshold=4, aggr="avg", seed=0,
+                  compile_cache=False, tensorboard=False,
+                  data_dir="/nonexistent_use_synthetic")
+
+
+# The CNN parameter tree used by every check config (models/cnn.py):
+# conv1/conv2 kernel+bias, dense1/dense2 kernel+bias = 8 leaves. The
+# budget formulas below take it as a parameter so a model change shows up
+# as a budget diff, not silent slack.
+def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
+    """The checked family matrix, keyed by spec name. Budget arithmetic
+    mirrors parallel/rounds.py's documented communication plan:
+
+    - loss pmean: 1 psum
+    - RLR vote (_sharded_robust_lr): 1 sign psum per leaf
+    - avg aggregate: 1 weighted-sum psum per leaf + 1 weight-total psum
+    - sign + RLR: 1 SHARED sign psum per leaf (_sharded_sign_shared —
+      the vote reads |s|, the aggregate sign(s); this pass measured that
+      the old rely-on-XLA-CSE version never actually merged its
+      channel-id'd all-reduces)
+    - faults: exactly 1 [m]-bit validation all_gather, nothing else
+
+    HLO ceilings add the partitioner's fixed overhead: on the measured
+    toolchain (jax 0.4.37, XLA:CPU, 8 devices) GSPMD inserts 3
+    all-reduces (+4 collective-permute, 1 all-gather) partitioning the
+    outer in-jit sample gather around the shard_map — a constant, not a
+    per-leaf term. A jax upgrade may shift it; re-measure via
+    --compiled --write-baseline and review the diff.
+    """
+    spmd_overhead = 3
+    zero = {p: 0 for p in COLLECTIVE_PRIMITIVES}
+    specs = {}
+
+    # vmap path: the whole point is NO collectives of any kind
+    specs["vmap_rlr_avg"] = CheckSpec(
+        name="vmap_rlr_avg", family="round", sharded=False,
+        cfg_overrides={}, collective_budget=dict(zero))
+    specs["vmap_eval"] = CheckSpec(
+        name="vmap_eval", family="eval_val", sharded=False,
+        cfg_overrides={}, collective_budget=dict(zero))
+
+    # flagship sharded defense: avg + RLR — psums only, no transposes
+    specs["sharded_rlr_avg"] = CheckSpec(
+        name="sharded_rlr_avg", family="round_sharded", sharded=True,
+        cfg_overrides={},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # sign + RLR: the vote and the aggregate SHARE one sign psum per leaf
+    # (_sharded_sign_shared) — n_leaves + 1 total, at both IR levels
+    specs["sharded_rlr_sign"] = CheckSpec(
+        name="sharded_rlr_sign", family="round_sharded", sharded=True,
+        cfg_overrides={"aggr": "sign", "server_lr": 1.0},
+        collective_budget={**zero, "psum": n_leaves + 1},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+
+    # faults on the sharded path: the ONLY added collective is the [m]-bit
+    # payload-validation all_gather (parallel/rounds.py docstring claim)
+    specs["sharded_rlr_avg_faults"] = CheckSpec(
+        name="sharded_rlr_avg_faults", family="round_sharded", sharded=True,
+        cfg_overrides={"dropout_rate": 0.3, "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # host-sampled sharded variant (the fedemnist-scale dispatch surface):
+    # same body, no in-jit sample gather — identical collective budget
+    specs["sharded_host_rlr_avg"] = CheckSpec(
+        name="sharded_host_rlr_avg", family="round_sharded_host",
+        sharded=True, host_mode=True, cfg_overrides={},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # chained sharded block: same per-round body inside a lax.scan — the
+    # static walk counts the body once, so the budget is unchanged
+    specs["sharded_chained_rlr_avg"] = CheckSpec(
+        name="sharded_chained_rlr_avg", family="chained_sharded",
+        sharded=True, cfg_overrides={"chain": 2, "snap": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    return specs
+
+
+def check_specs() -> Dict[str, CheckSpec]:
+    """Budgeted family matrix for the current check model (CNN, 8 leaves)."""
+    return collective_budgets(n_leaves=8)
+
+
+# --------------------------------------------------------------------------
+# Fingerprint-audit rules (analysis/fingerprint_audit.py)
+# --------------------------------------------------------------------------
+
+# Package modules whose cfg.<field> reads shape traced programs (builders
+# included: a builder-body read bakes the value into the trace). The
+# audit cross-checks every field read here against its provenance tag.
+PROGRAM_READ_MODULES = (
+    f"{PKG}/fl/",
+    f"{PKG}/ops/",
+    f"{PKG}/parallel/rounds.py",
+    f"{PKG}/faults/",
+    f"{PKG}/obs/telemetry.py",
+    f"{PKG}/models/",
+)
+
+# Provenance classes (config.FIELD_PROVENANCE values) and their
+# fingerprint rule:
+#   program  -> MUST be fingerprinted (never in EXCLUDED_FIELDS)
+#   shape    -> enters via example-arg avals; fingerprinting is harmless,
+#               exclusion is fine when an aval provably pins it
+#   data     -> changes dataset CONTENT only, never the program; either way
+#   runtime  -> driver/IO knob; MUST be excluded (fingerprinting one
+#               causes spurious recompiles — the drift this audit exists
+#               to catch)
+PROVENANCE_CLASSES = ("program", "shape", "data", "runtime")

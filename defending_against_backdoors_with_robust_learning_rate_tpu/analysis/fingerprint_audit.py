@@ -1,0 +1,205 @@
+"""Fingerprint-drift audit: config fields vs the AOT-bank cache key.
+
+The PR-2 compile-persistence layer keys banked executables by a
+fingerprint of "config fields that shape the traced program"
+(utils/compile_cache.fingerprint, with EXCLUDED_FIELDS carved out). That
+contract decays silently in both directions:
+
+- a NEW field that shapes traced code but lands in EXCLUDED_FIELDS makes
+  two different programs share one cache entry — a warm start then runs
+  the WRONG executable;
+- a runtime-only field left IN the fingerprint (the drift this repo
+  already accumulated: --coordinator addresses, --top_frac, the
+  unresolved --rng_impl string) splits identical programs across keys —
+  every sweep cell recompiles programs the bank already holds.
+
+This audit makes the contract mechanical and **fail-closed**:
+
+1. every `Config` field must carry a provenance tag in
+   `config.FIELD_PROVENANCE` (program | shape | data | runtime) — an
+   untagged (or stale) field is an error, so adding a flag forces the
+   author to declare where it lives;
+2. `program` fields must NOT be excluded; `runtime` fields MUST be
+   (contracts.PROVENANCE_CLASSES documents the rule per class);
+3. the tags are cross-checked against reality: every `cfg.<field>` read
+   by program-shaping modules (contracts.PROGRAM_READ_MODULES — the
+   traced round/eval code and its builders) must resolve to a
+   program/shape/data tag. Reads of `@property`s are mapped to their
+   underlying fields by parsing config.py itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses as _dc
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+    contracts)
+from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.ast_rules import (
+    Finding)
+
+# names through which traced/builder code reaches the config object
+_CFG_NAMES = frozenset({"cfg", "config", "plain_cfg", "plain"})
+
+
+def config_fields() -> Set[str]:
+    from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+        Config)
+    return {f.name for f in _dc.fields(Config)}
+
+
+def field_provenance() -> Dict[str, str]:
+    from defending_against_backdoors_with_robust_learning_rate_tpu import (
+        config)
+    return dict(getattr(config, "FIELD_PROVENANCE", {}))
+
+
+def excluded_fields() -> Set[str]:
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    return set(compile_cache.EXCLUDED_FIELDS)
+
+
+def property_field_map(config_path: str) -> Dict[str, Set[str]]:
+    """Map each Config @property to the concrete fields it reads, by
+    parsing config.py (so `cfg.agents_per_round` audits as
+    {num_agents, agent_frac})."""
+    with open(config_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=config_path)
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or node.name != "Config":
+            continue
+        for item in node.body:
+            if not isinstance(item, ast.FunctionDef):
+                continue
+            is_prop = any(isinstance(d, ast.Name) and d.id == "property"
+                          for d in item.decorator_list)
+            if not is_prop:
+                continue
+            reads: Set[str] = set()
+            for sub in ast.walk(item):
+                if (isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"):
+                    reads.add(sub.attr)
+            out[item.name] = reads
+    # properties can read other properties; resolve to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for name, reads in out.items():
+            extra: Set[str] = set()
+            for r in list(reads):
+                if r in out and r != name:
+                    extra |= out[r]
+            if not extra <= reads:
+                reads |= extra
+                changed = True
+    return out
+
+
+def program_field_reads(repo_root: str) -> Dict[str, List[Tuple[str, int]]]:
+    """field -> [(relpath, line)] of cfg.<field-or-property> reads inside
+    the program-shaping modules."""
+    config_path = os.path.join(repo_root, contracts.PKG, "config.py")
+    props = property_field_map(config_path)
+    fields = config_fields()
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    for relroot in contracts.PROGRAM_READ_MODULES:
+        absroot = os.path.join(repo_root, relroot)
+        paths: List[str] = []
+        if relroot.endswith("/"):
+            for base, dirs, files in os.walk(absroot):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                paths.extend(os.path.join(base, f) for f in files
+                             if f.endswith(".py"))
+        elif os.path.exists(absroot):
+            paths.append(absroot)
+        for path in sorted(paths):
+            relpath = os.path.relpath(path, repo_root)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=relpath)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in _CFG_NAMES):
+                    continue
+                name = node.attr
+                for field in (props.get(name, {name}) if name in props
+                              else {name}):
+                    if field in fields:
+                        reads.setdefault(field, []).append(
+                            (relpath, node.lineno))
+    return reads
+
+
+def audit(repo_root: str,
+          fields: Optional[Set[str]] = None,
+          provenance: Optional[Dict[str, str]] = None,
+          excluded: Optional[Set[str]] = None,
+          reads: Optional[Dict[str, List[Tuple[str, int]]]] = None,
+          ) -> List[Finding]:
+    """Run the audit; the keyword overrides exist so tests can plant
+    fields/tags without editing real modules. Returns findings (empty =
+    contract holds)."""
+    cfg_rel = f"{contracts.PKG}/config.py"
+    cc_rel = f"{contracts.PKG}/utils/compile_cache.py"
+    fields = config_fields() if fields is None else set(fields)
+    provenance = field_provenance() if provenance is None else provenance
+    excluded = excluded_fields() if excluded is None else set(excluded)
+    reads = program_field_reads(repo_root) if reads is None else reads
+    findings: List[Finding] = []
+
+    def err(path: str, message: str) -> None:
+        findings.append(Finding("fingerprint-drift", path, 1, message))
+
+    # 1. fail closed: every field tagged, every tag a real field/class
+    for field in sorted(fields - set(provenance)):
+        err(cfg_rel,
+            f"config field '{field}' has no provenance tag in "
+            f"FIELD_PROVENANCE; declare it as one of "
+            f"{contracts.PROVENANCE_CLASSES} so the fingerprint audit "
+            f"can hold it")
+    for field in sorted(set(provenance) - fields):
+        err(cfg_rel,
+            f"FIELD_PROVENANCE tags '{field}' which is not a Config "
+            f"field; remove the stale entry")
+    for field, cls in sorted(provenance.items()):
+        if cls not in contracts.PROVENANCE_CLASSES:
+            err(cfg_rel,
+                f"'{field}' has unknown provenance class {cls!r} "
+                f"(expected one of {contracts.PROVENANCE_CLASSES})")
+
+    # 2. class vs EXCLUDED_FIELDS consistency
+    for field, cls in sorted(provenance.items()):
+        if field not in fields:
+            continue
+        if cls == "program" and field in excluded:
+            err(cc_rel,
+                f"program-shaping field '{field}' is in EXCLUDED_FIELDS: "
+                f"two different traced programs would share one AOT cache "
+                f"entry — remove it from the exclusion list")
+        elif cls == "runtime" and field not in excluded:
+            err(cc_rel,
+                f"runtime-only field '{field}' is fingerprinted: "
+                f"changing it recompiles programs the bank already holds "
+                f"— add it to EXCLUDED_FIELDS")
+
+    # 3. tags vs reality: fields read by program-shaping code
+    for field in sorted(reads):
+        cls = provenance.get(field)
+        if cls == "runtime":
+            sites = ", ".join(f"{p}:{ln}" for p, ln in reads[field][:3])
+            err(cfg_rel,
+                f"'{field}' is tagged runtime but is read by "
+                f"program-shaping code ({sites}); tag it program/shape "
+                f"or move the read to the driver")
+        if cls in ("program", None) and field in excluded:
+            sites = ", ".join(f"{p}:{ln}" for p, ln in reads[field][:3])
+            err(cc_rel,
+                f"'{field}' is excluded from the fingerprint but read by "
+                f"program-shaping code ({sites})")
+    return findings

@@ -206,6 +206,91 @@ class Config:
         return dataclasses.replace(self, **kw)
 
 
+# --- field provenance (fingerprint audit, analysis/fingerprint_audit.py) ---
+# Every Config field must declare where it lives; the static-analysis CI
+# gate fails closed on a new field missing here. Classes
+# (analysis/contracts.py):
+#   program  shapes the traced round/eval program -> MUST be in the AOT
+#            fingerprint (never in compile_cache.EXCLUDED_FIELDS)
+#   shape    only changes array shapes -> pinned by the example-arg avals;
+#            fingerprinting is harmless, exclusion allowed when an aval
+#            provably carries it
+#   data     changes dataset CONTENT, never the program
+#   runtime  driver/IO knob -> MUST be excluded from the fingerprint
+#            (fingerprinting one recompiles identical programs)
+FIELD_PROVENANCE = {
+    "data": "program",            # selects model family + image geometry
+    "num_agents": "program",      # K: in-jit sampling range
+    "agent_frac": "program",      # m = floor(K*C): vmap width
+    "num_corrupt": "program",     # krum/trmean trim, corrupt-slot flags
+    "rounds": "runtime",          # dispatch count only
+    "aggr": "program",
+    "local_ep": "program",        # scan trip count
+    "bs": "program",              # batch shapes
+    "client_lr": "program",       # baked into the SGD step
+    "client_moment": "program",
+    "server_lr": "program",
+    "base_class": "data",         # poisoning source; host-side stamping
+    "target_class": "data",
+    "poison_frac": "data",
+    "pattern_type": "data",
+    "robustLR_threshold": "program",
+    "clip": "program",
+    "noise": "program",
+    "top_frac": "runtime",        # host-side Sign/* set algebra only
+    "snap": "runtime",            # eval cadence; schedule not program
+    "platform": "runtime",        # backend is fingerprinted directly
+    "seed": "runtime",            # keys are program ARGUMENTS
+    "coordinator": "runtime",     # process_count is fingerprinted
+    "num_processes": "runtime",
+    "process_id": "runtime",
+    "arch": "program",
+    "dtype": "program",
+    "rng_impl": "runtime",        # the RESOLVED impl is fingerprinted via
+                                  # jax_default_prng_impl; 'auto' must not
+                                  # split from its resolution
+    "mesh": "runtime",            # sharded families are never banked; the
+                                  # mesh-independent eval/vmap programs
+                                  # should be shared across mesh settings
+    "chain": "shape",             # round_ids aval pins the block length
+    "host_prefetch": "runtime",
+    "host_sampled": "runtime",    # selects the family; family names key
+                                  # the fingerprint already
+    "agent_chunk": "program",     # chunked lax.map vs full vmap
+    "remat": "program",
+    "remat_policy": "program",
+    "dropout_rate": "program",    # faults path is traced
+    "straggler_rate": "program",
+    "straggler_epochs": "program",
+    "corrupt_rate": "program",
+    "corrupt_mode": "program",
+    "payload_norm_cap": "program",
+    "faults_spare_corrupt": "program",
+    "rlr_threshold_mode": "program",
+    "compile_cache": "runtime",
+    "compile_cache_dir": "runtime",
+    "async_metrics": "runtime",
+    "telemetry": "program",       # adds outputs to the traced program
+    "spans": "runtime",
+    "heartbeat": "runtime",
+    "status_file": "runtime",
+    "data_dir": "runtime",
+    "log_dir": "runtime",
+    "checkpoint_dir": "runtime",
+    "resume": "runtime",
+    "eval_bs": "shape",           # eval batch geometry via pad_eval_set
+    "profile_dir": "runtime",
+    "use_pallas": "program",
+    "debug_nan": "program",       # checkify instruments the program (AOT
+                                  # bank is off, but the XLA cache is not)
+    "diagnostics": "program",     # per-family normalization in fingerprint()
+    "tensorboard": "runtime",
+    "synth_train_size": "shape",
+    "synth_val_size": "shape",
+    "synth_hardness": "data",
+}
+
+
 def _add_reference_flags(p: argparse.ArgumentParser) -> None:
     d = Config()
     p.add_argument("--data", type=str, default=d.data,

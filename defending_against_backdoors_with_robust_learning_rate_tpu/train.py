@@ -698,6 +698,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                         fisher_fn(prev_params, pval[0], hon_labels, pval[2]))[0]
                     upd_flat = (ravel_pytree(params)[0]
                                 - ravel_pytree(prev_params)[0])
+                    # --diagnostics is the synchronous research mode by
+                    # design (the async drain is disabled); these fetches
+                    # happen at snap cadence only.
+                    # static: ok(host-sync)
                     scalars, cum_net_mov = sign_agreement(
                         np.asarray(info["lr_flat"]), np.asarray(upd_flat),
                         np.asarray(f_adv), np.asarray(f_hon),
@@ -735,6 +739,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                     drain.submit(emit_eval, vals, rnd, rounds_done, elapsed)
                 else:
                     with tracer.span("metrics/host_sync", round=rnd):
+                        # this IS the --sync_metrics fallback path; async
+                        # mode routes the same fetch through the
+                        # MetricsDrain instead.
+                        # static: ok(host-sync)
                         vals = jax.device_get(vals)  # THE per-round sync
                     elapsed = time.perf_counter() - t_loop
                     emit_eval(vals, rnd, rounds_done, elapsed)

@@ -59,7 +59,10 @@ import jax.numpy as jnp
 # knobs). `snap`/`rounds`/`seed`/`chain` only alter which/how many
 # dispatches run; shapes (which DO change programs, e.g. the chained
 # block's round_ids length) enter the fingerprint through the
-# example-argument avals instead.
+# example-argument avals instead. This set is audited against
+# config.FIELD_PROVENANCE by analysis/fingerprint_audit.py: every
+# `runtime` field must be here, no `program` field may be — drift in
+# either direction fails the static-analysis CI gate.
 EXCLUDED_FIELDS = frozenset({
     "data_dir", "log_dir", "checkpoint_dir", "resume", "profile_dir",
     "tensorboard", "rounds", "snap", "seed", "chain", "host_prefetch",
@@ -421,6 +424,81 @@ def plan_programs(cfg, model, norm, fed,
         specs.append(ProgramSpec(family, eval_fn,
                                  (params_aval,) + eval_avals))
     return specs
+
+
+def plan_sharded_programs(cfg, model, norm, fed, mesh,
+                          host_mode: bool = False) -> List[ProgramSpec]:
+    """Enumerate the shard_map program families for an explicit `mesh`.
+
+    The AOT bank never serves these (their executables embed the live
+    mesh; train.run adopts them at runtime), but the static-analysis
+    passes (analysis/jaxpr_lint.py) need the exact jit objects + avals the
+    driver would dispatch, through the same planner vocabulary — this is
+    the lowering hook that keeps the analysis surface and the dispatch
+    surface from drifting."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+        make_sharded_chained_round_fn, make_sharded_round_fn,
+        make_sharded_round_fn_host)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        host_takes_flags)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        init_params)
+
+    image_shape = fed.train.images.shape[2:]
+    params_aval = jax.eval_shape(
+        lambda k: init_params(model, image_shape, k), jax.random.PRNGKey(0))
+    key_aval = abstractify(jax.random.PRNGKey(0))
+    data_avals = abstractify((fed.train.images, fed.train.labels,
+                              fed.train.sizes))
+    chain_n = chain_budget(cfg, host_mode)
+    plain = cfg.replace(diagnostics=False)
+    m = cfg.agents_per_round
+    specs: List[ProgramSpec] = []
+    if host_mode:
+        shard_avals = tuple(
+            jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
+            for a in data_avals)
+        flags = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
+                 if host_takes_flags(cfg) else ())
+        specs.append(ProgramSpec(
+            "round_sharded_host",
+            make_sharded_round_fn_host(plain, model, norm, mesh),
+            (params_aval, key_aval) + shard_avals + flags))
+        return specs
+    specs.append(ProgramSpec(
+        "round_sharded",
+        make_sharded_round_fn(plain, model, norm, mesh,
+                              *data_avals).jitted,
+        (params_aval, key_aval) + data_avals))
+    if cfg.diagnostics:
+        specs.append(ProgramSpec(
+            "round_sharded_diag",
+            make_sharded_round_fn(cfg, model, norm, mesh,
+                                  *data_avals).jitted,
+            (params_aval, key_aval) + data_avals))
+    if chain_n > 1:
+        ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
+        specs.append(ProgramSpec(
+            "chained_sharded",
+            make_sharded_chained_round_fn(plain, model, norm, mesh,
+                                          *data_avals).jitted,
+            (params_aval, key_aval, ids_aval) + data_avals))
+    return specs
+
+
+def trace_program(jit_obj, example_args):
+    """ClosedJaxpr of a planned program — trace only, no lowering, no
+    XLA. The analysis passes count primitives on this."""
+    args = abstractify(example_args)
+    if hasattr(jit_obj, "trace"):
+        return jit_obj.trace(*args).jaxpr
+    return jax.make_jaxpr(jit_obj)(*args)
+
+
+def lower_program(jit_obj, example_args):
+    """Lowered (StableHLO-level) program for a planned family; call
+    `.compile()` on the result for post-optimization HLO."""
+    return jit_obj.lower(*abstractify(example_args))
 
 
 def precompile(cfg, model, norm, fed, bank: AotBank,

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""CI entry point for the static-analysis gate (analysis/).
+
+Runs, in order, with a non-zero exit on any finding:
+
+1. AST rules + fingerprint audit (pure AST + config import — fast, no
+   programs built);
+2. jaxpr contracts for the single-device (vmap) families;
+3. jaxpr contracts for the shard_map families on a faked 8-device CPU
+   mesh (the tests/conftest.py trick), including the compiled-HLO
+   collective ceilings when --compiled (the CI default) is given.
+
+Equivalent to:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m defending_against_backdoors_with_robust_learning_rate_tpu.analysis \
+        --sharded --compiled
+
+but sets the env itself (before jax initializes) so it works as a bare
+`python scripts/check_static.py` anywhere.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fast", action="store_true",
+                    help="AST + audit only (no jax program builds)")
+    ap.add_argument("--no-compiled", action="store_true",
+                    help="skip the compiled-HLO collective ceilings "
+                         "(trace-level contracts only)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="refresh analysis_baseline.json instead of "
+                         "diffing against it")
+    args = ap.parse_args()
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.__main__ import (
+        main as analysis_main)
+
+    if args.fast:
+        return analysis_main(["--rules", "ast,audit"])
+    argv = ["--rules", "ast,audit,jaxpr", "--sharded"]
+    if not args.no_compiled:
+        argv.append("--compiled")
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    return analysis_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
