@@ -70,6 +70,17 @@ EXCLUDED_FIELDS = frozenset({
     # obs/: spans + heartbeat are host-side IO; `telemetry` is NOT here —
     # it adds outputs to the traced program, so it must key the cache
     "spans", "heartbeat", "status_file",
+    # fingerprint-drift fixes (ISSUE 4 audit): runtime-only fields that
+    # used to split identical programs across cache keys. `platform`
+    # (backend is fingerprinted directly), the multihost rendezvous
+    # triplet (process/device counts are fingerprinted), `top_frac`
+    # (host-side Sign/* set algebra), `rng_impl` (the RESOLVED impl keys
+    # via jax_default_prng_impl — the unresolved 'auto' string must not
+    # split from 'rbg' on TPU), `mesh` (sharded families are never
+    # banked; eval/vmap programs are mesh-independent and should share),
+    # `host_sampled` (family names already key the fingerprint).
+    "platform", "coordinator", "num_processes", "process_id", "top_frac",
+    "rng_impl", "mesh", "host_sampled",
 })
 
 # families built from cfg.replace(diagnostics=False) in the driver; their
