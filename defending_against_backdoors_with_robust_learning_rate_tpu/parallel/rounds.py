@@ -154,7 +154,7 @@ def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
             best = jnp.argmin(jnp.sum(srt[:, 1:k + 1], axis=1))
         agg = jax.tree_util.tree_unflatten(treedef, [
             _from_param_shard(chunk[best], L, u.shape[1:])
-            for (chunk, L), u in zip(shards, leaves)])
+            for (chunk, L), u in zip(shards, leaves, strict=True)])
     elif cfg.aggr == "rfa":
         # geometric median (smoothed Weiszfeld, ops/aggregate.agg_rfa
         # semantics): the iterate v is replicated; per-agent distances are
@@ -198,6 +198,46 @@ def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
     return agg
 
 
+def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
+                         mask_full=None):
+    """aggr='sign' + RLR: ONE sign-sum psum per leaf, read twice — the
+    vote takes |s| and the aggregate takes sign(s).
+
+    The code used to issue the two textually-identical psums and rely on
+    XLA CSE to merge them; the jaxpr contract checker measured that the
+    partitioned all-reduces (distinct channel ids) never CSE — 20
+    all-reduces where the plan promises 12 (analysis_baseline.json,
+    sharded_rlr_sign). Sharing the collective here makes the documented
+    budget true by construction; values are bit-identical (same
+    arithmetic, same order). Returns (lr_tree, agg_tree) with server
+    noise + empty-electorate guard applied, mirroring
+    _sharded_aggregate's tail."""
+    thr = float(cfg.robustLR_threshold)
+    if mask_local is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        updates = masking.zero_masked(updates, mask_local)
+        thr = masking.rlr_threshold(cfg, mask_full)
+    slr = cfg.effective_server_lr
+    leaves, treedef = jax.tree_util.tree_flatten(updates)
+    lr_leaves, agg_leaves = [], []
+    for u in leaves:
+        s = jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS)
+        lr_leaves.append(jnp.where(jnp.abs(s) >= thr, slr,
+                                   -slr).astype(jnp.float32))
+        agg_leaves.append(jnp.sign(s))
+    lr = jax.tree_util.tree_unflatten(treedef, lr_leaves)
+    agg = jax.tree_util.tree_unflatten(treedef, agg_leaves)
+    if cfg.noise > 0:
+        agg = tree.add(agg, gaussian_noise_like(agg, noise_key,
+                                                cfg.noise * cfg.clip))
+    if mask_local is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        agg = masking.guard_empty(agg, mask_full)
+    return lr, agg
+
+
 def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None):
     """RLR sign-agreement vote as a psum (src/aggregation.py:48-54 semantics,
     vote over exactly the m sampled agents — minus masked-out voters on the
@@ -237,7 +277,7 @@ def _sharded_pallas_apply(params, updates, sizes, cfg):
     p_leaves, treedef = jax.tree_util.tree_flatten(params)
     u_leaves = jax.tree_util.tree_leaves(updates)
     new_leaves = []
-    for p, u in zip(p_leaves, u_leaves):
+    for p, u in zip(p_leaves, u_leaves, strict=True):
         mb = u.shape[0]
         ssum, wsum = partial_vote_avg_flat(u.reshape(mb, -1), wn,
                                            interpret=interp)
@@ -315,12 +355,19 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
             return new_params, loss, {}
         with jax.named_scope("aggregate_rlr"):
-            if cfg.robustLR_threshold > 0:
-                lr = _sharded_robust_lr(updates, cfg, mask_local, mask_full)
+            if cfg.robustLR_threshold > 0 and cfg.aggr == "sign":
+                # vote + aggregate share one sign-sum psum per leaf (the
+                # CSE XLA was measured not to do — see _sharded_sign_shared)
+                lr, agg = _sharded_sign_shared(updates, cfg, noise_key,
+                                               mask_local, mask_full)
             else:
-                lr = cfg.effective_server_lr
-            agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
-                                     mask_local, mask_full)
+                if cfg.robustLR_threshold > 0:
+                    lr = _sharded_robust_lr(updates, cfg, mask_local,
+                                            mask_full)
+                else:
+                    lr = cfg.effective_server_lr
+                agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
+                                         mask_local, mask_full)
             new_params = apply_aggregate(params, lr, agg)
         loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
         extras = {}
