@@ -1,0 +1,486 @@
+"""Static-analysis subsystem (analysis/): AST rules, jaxpr contracts,
+fingerprint audit, CLI exit codes, and the pinned collective baseline.
+
+Each AST rule gets a tripping synthetic snippet AND a clean twin (the
+rule must fire on the bug and stay quiet on the idiom); the jaxpr
+contracts get a deliberately-broken toy program; the audit gets a
+planted unlisted config field. The repo-wide scans double as the
+permanent regression gate: the tree must stay finding-free."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+    ast_rules, contracts, fingerprint_audit, jaxpr_lint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# AST rules: synthetic snippets
+# --------------------------------------------------------------------------
+
+def _scan_snippet(tmp_path, source, relpath="scripts/profile_round.py"):
+    """Lint `source` as if it lived at `relpath` inside a repo."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return ast_rules.scan([str(path)], str(tmp_path))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_host_sync_trips_and_clean_twin(tmp_path):
+    bad = """
+    import jax
+    import numpy as np
+
+    def eval_loop(metrics, params):
+        v = float(metrics)
+        w = np.asarray(params)
+        x = metrics.item()
+        y = jax.device_get(metrics)
+        return v, w, x, y
+    """
+    assert _rules(_scan_snippet(tmp_path, bad)) == ["host-sync"]
+    assert len(_scan_snippet(tmp_path, bad)) == 4
+
+    clean = """
+    def eval_loop(cfg, metrics):
+        thr = float(cfg.robustLR_threshold)   # config scalar: trace-time
+        k = float(1e-3)                       # literal
+        return thr + k
+    """
+    assert _scan_snippet(tmp_path, clean) == []
+
+
+def test_host_sync_scoped_to_hot_modules(tmp_path):
+    src = """
+    def anywhere(x):
+        return float(x)
+    """
+    # same code outside the hot-path list is not flagged
+    assert _scan_snippet(tmp_path, src, relpath="scripts/plot_curves.py") \
+        == []
+    assert _rules(_scan_snippet(tmp_path, src)) == ["host-sync"]
+
+
+def test_jit_side_effect_trips_and_clean_twin(tmp_path):
+    bad = """
+    import time
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("tracing!")
+        t = time.perf_counter()
+        return x + t
+    """
+    f = _scan_snippet(tmp_path, bad, relpath="pkg/mod.py")
+    assert _rules(f) == ["jit-side-effect"] and len(f) == 2
+
+    clean = """
+    import time
+    import jax
+
+    def host_loop(x):            # not traced: side effects are fine
+        print("round", x)
+        return time.perf_counter()
+
+    @jax.jit
+    def step(x):
+        jax.debug.print("x={x}", x=x)   # the sanctioned in-jit print
+        return x + 1
+    """
+    assert _scan_snippet(tmp_path, clean, relpath="pkg/mod.py") == []
+
+
+def test_jit_side_effect_via_transform_argument(tmp_path):
+    src = """
+    import os
+    import jax
+
+    def body(c, x):
+        flag = os.environ.get("X")      # traced via lax.scan(body, ...)
+        return c, x
+
+    def run(xs):
+        return jax.lax.scan(body, 0, xs)
+    """
+    f = _scan_snippet(tmp_path, src, relpath="pkg/mod.py")
+    assert _rules(f) == ["jit-side-effect"]
+
+
+def test_jit_side_effect_closure_list_mutation(tmp_path):
+    bad = """
+    import jax
+
+    def make_step():
+        leaked = []
+
+        def step(x):             # nested in a make_ builder -> traced
+            leaked.append(x)     # closure mutation: trace-time only
+            return x + 1
+        return step
+    """
+    assert _rules(_scan_snippet(tmp_path, bad, relpath="pkg/mod.py")) \
+        == ["jit-side-effect"]
+
+    clean = """
+    import jax
+
+    def make_step():
+        def step(xs):
+            ys = []
+            for i in range(3):
+                ys.append(xs[i])   # local accumulation: fine
+            return ys
+        return step
+    """
+    assert _scan_snippet(tmp_path, clean, relpath="pkg/mod.py") == []
+
+
+def test_prng_reuse_trips_and_rotation_is_clean(tmp_path):
+    bad = """
+    import jax
+
+    def draw(key, shape):
+        a = jax.random.uniform(key, shape)
+        b = jax.random.normal(key, shape)    # same key consumed twice
+        return a + b
+    """
+    assert _rules(_scan_snippet(tmp_path, bad, relpath="pkg/mod.py")) \
+        == ["prng-reuse"]
+
+    clean = """
+    import jax
+
+    def draw(key, shape):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.uniform(k1, shape)
+        b = jax.random.normal(k2, shape)
+        return a + b
+
+    def rotate(key, n):
+        out = []
+        for _ in range(n):
+            key, sub = jax.random.split(key)   # rotation idiom
+            out.append(jax.random.uniform(sub, ()))
+        return out
+    """
+    assert _scan_snippet(tmp_path, clean, relpath="pkg/mod.py") == []
+
+
+def test_prng_unused_split_trips_and_closure_use_is_clean(tmp_path):
+    bad = """
+    import jax
+
+    def draw(key):
+        k1, k2 = jax.random.split(key)
+        return jax.random.uniform(k1, ())    # k2 is dead entropy
+    """
+    assert _rules(_scan_snippet(tmp_path, bad, relpath="pkg/mod.py")) \
+        == ["prng-unused-split"]
+
+    clean = """
+    import jax
+
+    def draw(key):
+        k1, k2 = jax.random.split(key)
+
+        def inner(b):
+            return jax.random.fold_in(k2, b)   # closure use counts
+        return jax.random.uniform(k1, ()), inner
+    """
+    assert _scan_snippet(tmp_path, clean, relpath="pkg/mod.py") == []
+
+
+def test_donate_reuse_trips_and_rebind_is_clean(tmp_path):
+    bad = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(params, x):
+        return params, x
+
+    def loop(params, xs):
+        out, _ = step(params, xs)
+        return params            # donated buffer read after the call
+    """
+    assert _rules(_scan_snippet(tmp_path, bad, relpath="pkg/mod.py")) \
+        == ["donate-reuse"]
+
+    clean = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(params, x):
+        return params, x
+
+    def loop(params, xs):
+        params, _ = step(params, xs)   # rebound on the call line
+        return params
+    """
+    assert _scan_snippet(tmp_path, clean, relpath="pkg/mod.py") == []
+
+
+def test_pragma_and_allow_suppression(tmp_path):
+    src = """
+    def eval_loop(metrics):
+        # static: ok(host-sync)
+        v = float(metrics)
+        w = metrics.item()    # not covered by the pragma above
+        return v + w
+    """
+    f = _scan_snippet(tmp_path, src)
+    assert len(f) == 1 and f[0].rule == "host-sync"
+    assert "item" in f[0].message
+
+
+def test_repo_ast_scan_is_clean():
+    """Satellite contract: the tree stays finding-free. A new finding
+    here means either fix the code or add a justified ALLOW/pragma."""
+    findings = ast_rules.scan_repo(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# --------------------------------------------------------------------------
+# fingerprint audit
+# --------------------------------------------------------------------------
+
+def test_audit_clean_on_tree():
+    assert fingerprint_audit.audit(REPO) == []
+
+
+def test_audit_catches_planted_unlisted_field():
+    prov = fingerprint_audit.field_provenance()
+    fields = fingerprint_audit.config_fields() | {"new_knob"}
+    f = fingerprint_audit.audit(REPO, fields=fields, provenance=prov)
+    assert len(f) == 1 and "new_knob" in f[0].message
+    assert "provenance" in f[0].message
+
+
+def test_audit_catches_program_field_excluded():
+    prov = fingerprint_audit.field_provenance()
+    excl = fingerprint_audit.excluded_fields() | {"bs"}   # program field!
+    f = fingerprint_audit.audit(REPO, excluded=excl)
+    msgs = "\n".join(x.message for x in f)
+    assert any("'bs'" in x.message and "EXCLUDED_FIELDS" in x.message
+               for x in f), msgs
+
+
+def test_audit_catches_runtime_field_fingerprinted():
+    excl = fingerprint_audit.excluded_fields() - {"top_frac"}
+    f = fingerprint_audit.audit(REPO, excluded=excl)
+    assert any("'top_frac'" in x.message and "fingerprinted" in x.message
+               for x in f)
+
+
+def test_audit_catches_runtime_tag_on_program_read_field():
+    prov = dict(fingerprint_audit.field_provenance())
+    prov["bs"] = "runtime"   # bs is read by fl/client.py's builder
+    f = fingerprint_audit.audit(REPO, provenance=prov)
+    assert any("'bs'" in x.message and "program-shaping" in x.message
+               for x in f)
+
+
+def test_property_reads_map_to_fields():
+    cfg_path = os.path.join(REPO, contracts.PKG, "config.py")
+    props = fingerprint_audit.property_field_map(cfg_path)
+    assert props["agents_per_round"] == {"num_agents", "agent_frac"}
+    assert "dropout_rate" in props["faults_enabled"]
+    reads = fingerprint_audit.program_field_reads(REPO)
+    # fl/rounds reads cfg.agents_per_round -> both underlying fields seen
+    assert "num_agents" in reads and "agent_frac" in reads
+
+
+# --------------------------------------------------------------------------
+# jaxpr contracts
+# --------------------------------------------------------------------------
+
+def test_collective_counting_on_toy_shard_map():
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.compat import (
+        shard_map)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("agents",))
+
+    def body(x):
+        s = jax.lax.psum(jnp.sum(x), "agents")
+        t = jax.lax.psum(jnp.sum(x * 2), "agents")
+        g = jax.lax.all_gather(x, "agents", axis=0, tiled=True)
+        return s + t + jnp.sum(g)
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("agents"),),
+                          out_specs=P()))
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    closed = compile_cache.trace_program(
+        f, (jax.ShapeDtypeStruct((8, 4), jnp.float32),))
+    counts = jaxpr_lint.collective_counts(closed)
+    assert counts["psum"] == 2 and counts["all_gather"] == 1
+
+
+def test_forbidden_primitive_detected_on_broken_toy():
+    import jax.numpy as jnp
+
+    @jax.jit
+    def leaky(x):
+        jax.debug.print("x={x}", x=x)   # debug_callback: forbidden
+        return x + 1
+
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    closed = compile_cache.trace_program(
+        leaky, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    sites = jaxpr_lint.forbidden_sites(closed)
+    assert sites and "debug_callback" in sites[0]
+    assert jaxpr_lint.forbidden_sites(
+        compile_cache.trace_program(
+            jax.jit(lambda x: x + 1),
+            (jax.ShapeDtypeStruct((4,), jnp.float32),))) == []
+
+
+def test_budget_violation_fails_and_within_budget_passes(monkeypatch):
+    """A deliberately tightened budget must produce a collective-budget
+    finding; the real budget must not."""
+    specs = contracts.check_specs()
+    ok = specs["sharded_rlr_avg"]
+    findings, record = jaxpr_lint.check_family(ok)
+    assert findings == []
+    assert record["collectives"]["psum"] == ok.collective_budget["psum"]
+
+    import dataclasses
+    broken = dataclasses.replace(
+        ok, collective_budget={**ok.collective_budget,
+                               "psum": ok.collective_budget["psum"] - 1})
+    findings, _ = jaxpr_lint.check_family(broken)
+    assert len(findings) == 1 and findings[0].rule == "collective-budget"
+
+
+def test_vmap_family_has_zero_collectives():
+    findings, record = jaxpr_lint.check_family(
+        contracts.check_specs()["vmap_rlr_avg"])
+    assert findings == []
+    assert record["collectives"] == {}
+
+
+def test_telemetry_off_is_inert():
+    assert jaxpr_lint.telemetry_off_findings(sharded=False) == []
+
+
+def test_telemetry_on_would_trip_the_tripwire(monkeypatch):
+    """Inverse control: the tripwire actually guards the telemetry call
+    path (a telemetry=basic trace must hit it)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        telemetry)
+    import dataclasses
+    spec = contracts.check_specs()["vmap_rlr_avg"]
+    spec_on = dataclasses.replace(
+        spec, cfg_overrides={**spec.cfg_overrides, "telemetry": "basic"})
+
+    def tripwire(*a, **k):
+        raise AssertionError("tripwire")
+
+    monkeypatch.setattr(telemetry, "compute", tripwire)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    jit_obj, example_args = jaxpr_lint.build_family(spec_on)
+    with pytest.raises(AssertionError, match="tripwire"):
+        compile_cache.trace_program(jit_obj, example_args)
+
+
+def test_sharded_collective_counts_match_pinned_baseline():
+    """ISSUE-4 acceptance: the shard_map round-family collective counts
+    are pinned in analysis_baseline.json and asserted in tier-1 (exact
+    when the jax version matches; the budgets gate regardless)."""
+    path = jaxpr_lint.baseline_path(REPO)
+    assert os.path.exists(path), "analysis_baseline.json missing"
+    with open(path) as f:
+        pinned = json.load(f)
+    for name in ("sharded_rlr_avg", "sharded_rlr_sign",
+                 "sharded_rlr_avg_faults"):
+        spec = contracts.check_specs()[name]
+        findings, record = jaxpr_lint.check_family(spec)
+        assert findings == [], findings
+        if pinned.get("jax") == jax.__version__:
+            assert record["collectives"] == \
+                pinned["families"][name]["collectives"], name
+
+
+def test_sign_vote_psum_sharing():
+    """The collective-budget fix this PR landed: sign + RLR share one
+    sign psum per leaf (n_leaves + 1 total with the loss pmean), not the
+    old 2n + 1."""
+    _, record = jaxpr_lint.check_family(
+        contracts.check_specs()["sharded_rlr_sign"])
+    n_leaves = 8
+    assert record["collectives"]["psum"] == n_leaves + 1
+
+
+def test_faults_adds_exactly_one_all_gather():
+    _, plain = jaxpr_lint.check_family(
+        contracts.check_specs()["sharded_rlr_avg"])
+    _, faults = jaxpr_lint.check_family(
+        contracts.check_specs()["sharded_rlr_avg_faults"])
+    assert plain["collectives"].get("all_gather", 0) == 0
+    assert faults["collectives"]["all_gather"] == 1
+    assert faults["collectives"]["psum"] == plain["collectives"]["psum"]
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _run_cli(args, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m",
+         f"{contracts.PKG}.analysis"] + args,
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_cli_exit_zero_on_clean_tree():
+    r = _run_cli(["--rules", "ast,audit"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_exit_one_on_planted_finding(tmp_path, monkeypatch, capsys):
+    """Plant a forbidden host sync in a throwaway hot-path copy of the
+    repo surface and check the CLI exits 1 (the CI gate behavior)."""
+    plant = tmp_path / "scripts" / "profile_round.py"
+    plant.parent.mkdir(parents=True)
+    plant.write_text("def hot(metrics):\n    return float(metrics)\n")
+    findings = ast_rules.scan([str(plant)], str(tmp_path))
+    assert [f.rule for f in findings] == ["host-sync"]
+    # the CLI maps findings -> exit 1 (in-process, scan_repo planted)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.__main__ import (
+        main as cli_main)
+    monkeypatch.setattr(ast_rules, "scan_repo", lambda root: findings)
+    assert cli_main(["--rules", "ast"]) == 1
+    assert "host-sync" in capsys.readouterr().out
+    monkeypatch.setattr(ast_rules, "scan_repo", lambda root: [])
+    assert cli_main(["--rules", "ast"]) == 0
+
+
+def test_cli_json_clean_tree():
+    r = _run_cli(["--rules", "ast,audit", "--json"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout) == []
+
+
+def test_cli_rejects_unknown_rules():
+    r = _run_cli(["--rules", "nope"])
+    assert r.returncode == 2
