@@ -211,7 +211,7 @@ def pack_uneven(shard_images: List[np.ndarray], shard_labels: List[np.ndarray],
                    or x.shape[1:] != shard_images[0].shape[1:]
                    for x in shard_images)
             or any(len(y) != len(x)
-                   for x, y in zip(shard_images, shard_labels))):
+                   for x, y in zip(shard_images, shard_labels, strict=True))):
         return stack_uneven_shards(shard_images, shard_labels, pad_multiple)
     imgs = [np.ascontiguousarray(x) for x in shard_images]
     lbls = [np.ascontiguousarray(y, dtype=np.int32) for y in shard_labels]

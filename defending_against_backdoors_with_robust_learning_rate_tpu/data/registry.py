@@ -123,7 +123,7 @@ def _load_cifar10(data_dir: str) -> Optional[Tuple[RawDataset, RawDataset]]:
         imgs = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
         return imgs.astype(np.uint8), np.asarray(d[b"labels"], np.int32)
 
-    tr_i, tr_l = zip(*[load_batch(f"data_batch_{i}") for i in range(1, 6)])
+    tr_i, tr_l = zip(*[load_batch(f"data_batch_{i}") for i in range(1, 6)], strict=True)
     te_i, te_l = load_batch("test_batch")
     return (RawDataset(np.concatenate(tr_i), np.concatenate(tr_l), "cifar10"),
             RawDataset(te_i, te_l, "cifar10"))

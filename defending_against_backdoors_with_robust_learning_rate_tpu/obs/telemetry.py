@@ -165,7 +165,7 @@ def compute(cfg, updates, lr, agg, mask=None, corrupt_flags=None):
         dots = jnp.zeros((m,), jnp.float32)
         usq = jnp.zeros((m,), jnp.float32)
         for u, a in zip(jax.tree_util.tree_leaves(updates),
-                        jax.tree_util.tree_leaves(agg)):
+                        jax.tree_util.tree_leaves(agg), strict=True):
             uf = u.reshape(m, -1).astype(jnp.float32)
             af = a.reshape(-1).astype(jnp.float32)
             s = jnp.abs(jnp.sum(jnp.sign(uf), axis=0))
@@ -212,7 +212,7 @@ def compute_sharded(cfg, updates_local, lr, agg, axis_name,
         dots_l = jnp.zeros((mb,), jnp.float32)
         usq_l = jnp.zeros((mb,), jnp.float32)
         for u, a in zip(jax.tree_util.tree_leaves(updates_local),
-                        jax.tree_util.tree_leaves(agg)):
+                        jax.tree_util.tree_leaves(agg), strict=True):
             uf = u.reshape(mb, -1).astype(jnp.float32)
             af = a.reshape(-1).astype(jnp.float32)
             # same psum the sharded RLR vote issues -> CSE'd when RLR is on

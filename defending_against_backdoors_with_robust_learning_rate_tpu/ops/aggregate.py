@@ -209,7 +209,7 @@ def gaussian_noise_like(params_like, key, std: float):
     leaves, treedef = jax.tree_util.tree_flatten(params_like)
     keys = jax.random.split(key, len(leaves))
     noisy = [jax.random.normal(k, x.shape, jnp.float32) * std
-             for k, x in zip(keys, leaves)]
+             for k, x in zip(keys, leaves, strict=True)]
     return jax.tree_util.tree_unflatten(treedef, noisy)
 
 

@@ -71,7 +71,7 @@ def maybe_unrolled_scan(body, init, xs, python_mode: bool):
     carry = init
     ys = []
     for i in range(length):
-        x_i = jax.tree_util.tree_map(lambda a: a[i], xs)
+        x_i = jax.tree_util.tree_map(lambda a, i=i: a[i], xs)
         carry, y = body(carry, x_i)
         ys.append(y)
     if not ys or all(

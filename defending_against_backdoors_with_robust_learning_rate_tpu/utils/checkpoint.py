@@ -72,7 +72,9 @@ def restore(ckpt_dir: str, params_like
         try:
             state = dict(_ckptr().restore(path, legacy))
         except ValueError:
-            raise e
+            # surface the ORIGINAL error; the legacy retry is diagnostic
+            # noise (B904: explicit cause, not implicit context chaining)
+            raise e from None
         state["cum_net_mov"] = np.asarray(0.0, np.float64)
     key_data = np.asarray(state["key"])
     if key_data.shape != key_shape:

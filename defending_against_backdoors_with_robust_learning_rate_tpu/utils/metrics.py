@@ -118,7 +118,7 @@ class MetricsDrain:
                         fetched = jax.device_get([d for _, d, _ in batch])
                 else:
                     fetched = jax.device_get([d for _, d, _ in batch])
-                for (fn, _, host_args), vals in zip(batch, fetched):
+                for (fn, _, host_args), vals in zip(batch, fetched, strict=True):
                     fn(vals, *host_args)
             except BaseException as e:  # noqa: BLE001 — re-raised at flush
                 with self._cond:

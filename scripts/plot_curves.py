@@ -92,7 +92,7 @@ def main():
                 continue
             steps = sorted(int(s) for s in curves)
             ys = [curves[str(s)].get(tag) for s in steps]
-            pts = [(s, y) for s, y in zip(steps, ys) if y is not None]
+            pts = [(s, y) for s, y in zip(steps, ys, strict=True) if y is not None]
             if not pts:
                 continue
             family, variant = split_name(r["name"])

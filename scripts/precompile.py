@@ -102,7 +102,8 @@ def main():
             continue
         rows = compile_cache.precompile(
             cfg, model, norm, fed, bank,
-            log=lambda m: print(f"[{name}] {m}", file=sys.stderr))
+            log=lambda m, name=name: print(f"[{name}] {m}",
+                                           file=sys.stderr))
         summary.extend({"config": name, "family": r["family"],
                         "cache_hit": r["cache_hit"],
                         "seconds": r["seconds"]} for r in rows)

@@ -93,7 +93,7 @@ def test_async_metrics_jsonl_identical_to_sync(tmp_path):
     assert [(r["tag"], r["step"]) for r in ra] == \
            [(r["tag"], r["step"]) for r in rs]
     compared = 0
-    for a, s in zip(ra, rs):
+    for a, s in zip(ra, rs, strict=True):
         if a["tag"] in WALLCLOCK or a["tag"].startswith("Throughput/"):
             continue
         assert a["value"] == s["value"], (a, s)

@@ -37,7 +37,7 @@ def _setup(num_agents=4):
 
 def _assert_trees_close(a, b, **kw):
     for x, y in zip(jax.tree_util.tree_leaves(a),
-                    jax.tree_util.tree_leaves(b)):
+                    jax.tree_util.tree_leaves(b), strict=True):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
 
 

@@ -157,5 +157,5 @@ def test_resume_mid_chain_continues_exact_sequence(tmp_path, host_sampled):
     assert rnd_b == 10
 
     for a, b in zip(jax.tree_util.tree_leaves(p_a),
-                    jax.tree_util.tree_leaves(p_b)):
+                    jax.tree_util.tree_leaves(p_b), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

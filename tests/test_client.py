@@ -96,7 +96,7 @@ def test_padded_batches_are_noops():
         params, jnp.asarray(x8), jnp.asarray(y8), jnp.int32(4),
         jax.random.PRNGKey(7))
     for a, b in zip(jax.tree_util.tree_leaves(up_tight),
-                    jax.tree_util.tree_leaves(up_padded)):
+                    jax.tree_util.tree_leaves(up_padded), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
@@ -141,6 +141,6 @@ def test_python_loop_path_matches_scan(monkeypatch):
     # differently so results match to ~1 ulp, not bitwise (measured 3e-8)
     np.testing.assert_allclose(float(loss_py), float(loss_scan), rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(up_py),
-                    jax.tree_util.tree_leaves(up_scan)):
+                    jax.tree_util.tree_leaves(up_scan), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-5)

@@ -33,7 +33,7 @@ def _run(cfg, rounds):
     pval = pad_eval_set(fed.pval_images, fed.pval_labels, cfg.eval_bs)
 
     key = jax.random.PRNGKey(cfg.seed)
-    for r in range(rounds):
+    for _r in range(rounds):
         key, sub = jax.random.split(key)
         params, _ = round_fn(params, sub)
     _, val_acc, _ = eval_fn(params, *map(jnp.asarray, val))
@@ -88,7 +88,7 @@ def test_host_sampled_mode_trains():
     rng = np.random.default_rng(0)
     losses = []
     key = jax.random.PRNGKey(9)
-    for rnd in range(4):
+    for _rnd in range(4):
         key, sub = jax.random.split(key)
         ids = rng.choice(cfg.num_agents, cfg.agents_per_round, replace=False)
         params, info = host_fn(params, sub,

@@ -62,7 +62,7 @@ def _dense(aggr, u, sizes, mask=None):
 
 
 def _leaves_equal(a, b):
-    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b), strict=True):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
@@ -190,7 +190,7 @@ def test_dropout_round_sharded_matches_vmap():
     p2, i2 = make_sharded_round_fn(cfg, model, norm, make_mesh(8), *arrays)(
         params, key)
     for a, b in zip(jax.tree_util.tree_leaves(p1),
-                    jax.tree_util.tree_leaves(p2)):
+                    jax.tree_util.tree_leaves(p2), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
     assert float(i1["fault_voters"]) == float(i2["fault_voters"]) \
@@ -256,7 +256,7 @@ def test_masked_aggregate_ignores_nan_payloads(aggr):
     u7 = jax.tree_util.tree_map(lambda x: x[keep], u)
     expect = _dense(aggr, u7, sizes[jnp.asarray(keep)])
     for a, b in zip(jax.tree_util.tree_leaves(masked),
-                    jax.tree_util.tree_leaves(expect)):
+                    jax.tree_util.tree_leaves(expect), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
 
@@ -313,7 +313,7 @@ def test_straggler_budget_truncates_local_training():
                               key, jnp.int32(1))
     assert any(not np.array_equal(np.asarray(a), np.asarray(b))
                for a, b in zip(jax.tree_util.tree_leaves(u_one),
-                               jax.tree_util.tree_leaves(u_full)))
+                               jax.tree_util.tree_leaves(u_full), strict=True))
 
 
 def test_all_invalid_round_is_a_finite_noop():
@@ -375,7 +375,7 @@ def test_chained_faults_match_per_round_dispatch():
     chained = make_chained_round_fn(cfg, model, norm, *arrays)
     p_chain, stacked = chained(params, base_key, jnp.arange(1, n + 1))
     for a, b in zip(jax.tree_util.tree_leaves(p_seq),
-                    jax.tree_util.tree_leaves(p_chain)):
+                    jax.tree_util.tree_leaves(p_chain), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(stacked["fault_voters"]),

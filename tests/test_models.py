@@ -106,7 +106,7 @@ def test_resnet9_remat_matches_unremated():
     l2, g2 = jax.value_and_grad(loss(model_r))(params)
     assert jnp.allclose(l1, l2, rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g1),
-                    jax.tree_util.tree_leaves(g2)):
+                    jax.tree_util.tree_leaves(g2), strict=True):
         assert jnp.allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
@@ -132,5 +132,5 @@ def test_resnet9_selective_remat_matches_block():
     l2, g2 = jax.value_and_grad(loss(model_c))(params)
     assert jnp.allclose(l1, l2, rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g1),
-                    jax.tree_util.tree_leaves(g2)):
+                    jax.tree_util.tree_leaves(g2), strict=True):
         assert jnp.allclose(a, b, rtol=1e-5, atol=1e-6)

@@ -126,7 +126,7 @@ def test_two_process_host_sampled_trains():
         assert "training REDUNDANTLY" not in out, out
 
     summaries = {}
-    for pid, (rc, out, err) in enumerate(outs):
+    for pid, (_rc, out, _err) in enumerate(outs):
         for line in out.splitlines():
             if line.startswith(f"SUMMARY{pid}="):
                 summaries[pid] = json.loads(line.split("=", 1)[1])
@@ -167,7 +167,7 @@ def test_two_process_global_mesh_trains(tmp_path):
         assert rc == 0, f"rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
 
     summaries, resumed = {}, {}
-    for pid, (rc, out, err) in enumerate(outs):
+    for pid, (_rc, out, _err) in enumerate(outs):
         for line in out.splitlines():
             if line.startswith(f"SUMMARY{pid}="):
                 summaries[pid] = json.loads(line.split("=", 1)[1])
@@ -186,7 +186,7 @@ def test_two_process_global_mesh_trains(tmp_path):
     # continued to round 4. The resumed-marker assertion keeps this
     # non-vacuous: without it a silent fall-back to training from scratch
     # would also report round=4 with identical losses.
-    for rc, out, err in outs:
+    for _rc, out, _err in outs:
         assert "[ckpt] resumed from round 2" in out, out
     assert set(resumed) == {0, 1}, resumed
     assert resumed[0]["round"] == resumed[1]["round"] == 4

@@ -284,7 +284,7 @@ def test_telemetry_off_params_bit_identical_to_full():
     assert not any(k.startswith("tel_") for k in info_off)
     assert any(k.startswith("tel_") for k in info_full)
     for a, b in zip(jax.tree_util.tree_leaves(p_off),
-                    jax.tree_util.tree_leaves(p_full)):
+                    jax.tree_util.tree_leaves(p_full), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
@@ -424,7 +424,7 @@ def test_sweep_faults_rows_and_cells(tmp_path, monkeypatch):
     rows = [json.loads(line) for line in open(out)]
     assert len(rows) == 2 and len(seen) == 2
     assert [r["dropout_rate"] for r in rows] == [0.0, 0.3]
-    for row, cfg in zip(rows, seen):
+    for row, cfg in zip(rows, seen, strict=True):
         assert row["rlr_threshold_mode"] == "scaled"
         assert row["faults_spare_corrupt"] is True
         assert {"val_acc", "poison_acc", "rounds_per_sec"} <= set(row)

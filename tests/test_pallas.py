@@ -51,7 +51,7 @@ def test_fused_tree_matches_jnp_path():
     agg = agg_avg(updates, w)
     expect = apply_aggregate(params, lr, agg)
     for a, b in zip(jax.tree_util.tree_leaves(got),
-                    jax.tree_util.tree_leaves(expect)):
+                    jax.tree_util.tree_leaves(expect), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
 
@@ -102,7 +102,7 @@ def test_fused_sign_round_matches_jnp_round():
     p_pl, _ = make_round_fn(cfg.replace(use_pallas=True), model, norm,
                             *arrays)(params, key)
     for a, b in zip(jax.tree_util.tree_leaves(p_jnp),
-                    jax.tree_util.tree_leaves(p_pl)):
+                    jax.tree_util.tree_leaves(p_pl), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
 
@@ -134,7 +134,7 @@ def test_round_with_pallas_matches_default():
     p2, _ = make_round_fn(cfg.replace(use_pallas=True), model, norm,
                           *arrays)(params, key)
     for a, b in zip(jax.tree_util.tree_leaves(p1),
-                    jax.tree_util.tree_leaves(p2)):
+                    jax.tree_util.tree_leaves(p2), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
 
@@ -174,6 +174,6 @@ def test_sharded_round_with_pallas_matches_default():
         p2, _ = make_sharded_round_fn(cfg.replace(use_pallas=True), model,
                                       norm, mesh, *arrays)(params, key)
         for a, b in zip(jax.tree_util.tree_leaves(p1),
-                        jax.tree_util.tree_leaves(p2)):
+                        jax.tree_util.tree_leaves(p2), strict=True):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-5)

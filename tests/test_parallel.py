@@ -64,7 +64,7 @@ def test_sharded_round_matches_vmap_round(aggr):
     np.testing.assert_array_equal(np.asarray(info1["sampled"]),
                                   np.asarray(info2["sampled"]))
     for a, b in zip(jax.tree_util.tree_leaves(p1),
-                    jax.tree_util.tree_leaves(p2)):
+                    jax.tree_util.tree_leaves(p2), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(float(info1["train_loss"]),
@@ -128,7 +128,7 @@ def test_sharded_multiround_trains():
     sharded = make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
     key = jax.random.PRNGKey(0)
     losses = []
-    for r in range(4):
+    for _r in range(4):
         key, sub = jax.random.split(key)
         params, info = sharded(params, sub)
         losses.append(float(info["train_loss"]))
@@ -165,7 +165,7 @@ def test_sharded_host_round_matches_single_device_host():
                         *(jax.device_put(a, sharding) for a in gathered))
 
     for a, b in zip(jax.tree_util.tree_leaves(p1),
-                    jax.tree_util.tree_leaves(p2)):
+                    jax.tree_util.tree_leaves(p2), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(float(info1["train_loss"]),

@@ -196,7 +196,7 @@ def _ref_robust_lr(update_vecs, threshold, server_lr):
 
 def _ref_aggregate(update_vecs, sizes, aggr):
     if aggr == "avg":       # src/aggregation.py:57-64
-        sm = sum(n * u for n, u in zip(sizes, update_vecs))
+        sm = sum(n * u for n, u in zip(sizes, update_vecs, strict=True))
         return sm / sum(sizes)
     if aggr == "comed":     # src/aggregation.py:66-69 (torch lower median)
         cat = torch.cat([u.view(-1, 1) for u in update_vecs], dim=1)
