@@ -204,6 +204,17 @@ def main():
     ap.add_argument("--status_file", default="logs/status.json",
                     help="heartbeat path (obs/heartbeat.py) the session "
                          "stall detector reads; empty disables")
+    ap.add_argument("--profile_rounds", type=int, default=0,
+                    help=">0: after the timed steady blocks, capture a "
+                         "jax.profiler window of (at least) this many "
+                         "extra rounds and attribute device time "
+                         "(obs/attribution.py: compute/collective/gap + "
+                         "named-scope split as `attribution` in the "
+                         "output JSON; the timed figure is unaffected)")
+    ap.add_argument("--profile_trace_dir", default="logs/bench_profile",
+                    help="where the --profile_rounds capture lands "
+                         "(re-parse offline via scripts/trace_top_ops.py "
+                         "--parse or python -m ...obs.report)")
     ap.add_argument("--remat_policy", choices=("block", "conv", "none"),
                     default="block",
                     help="resnet9 config only: block = full blockwise "
@@ -346,7 +357,7 @@ def main():
               jnp.asarray(fed.train.sizes))
     chain = args.chain
 
-    def measure(mcfg, label=""):
+    def measure(mcfg, label="", profile_dir=None):
         """Compile (or load the banked executable) + steady-state
         rounds/sec of mcfg's chained round fn. Returns (params,
         rounds_per_sec, compile_s, cache_info) where compile_s keeps its
@@ -414,9 +425,63 @@ def main():
         rounds_per_sec = n_rounds / elapsed
         log(f"[bench]{label} {n_rounds} rounds in {elapsed:.2f}s "
             f"-> {rounds_per_sec:.3f} rounds/sec steady-state")
+
+        if profile_dir and args.profile_rounds > 0:
+            # device-time attribution window (obs/attribution.py): EXTRA
+            # steady blocks under the profiler, after the timed ones, so
+            # capture overhead never touches the headline figure
+            from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                attribution)
+            p_blocks = -(-args.profile_rounds // chain)
+            if jax.default_backend() != "tpu":
+                # XLA:CPU's profiler records every op thunk of the
+                # conv-in-loop path: full-shape CPU rounds serialize
+                # multi-minute, multi-GB traces at stop_trace. Useful
+                # only on reduced shapes (the CI smoke) — say so.
+                log("[bench] WARNING: profiling a non-TPU backend — "
+                    "stop_trace serialization can take minutes on "
+                    "full-shape CPU rounds (fine on reduced shapes)")
+            hb.update(phase="profile", force=True)
+            with tracer.span("bench/profile_blocks", blocks=p_blocks):
+                jax.profiler.start_trace(profile_dir)
+                for b in range(args.blocks, args.blocks + p_blocks):
+                    ids = jnp.arange((b + 1) * chain + 1,
+                                     (b + 2) * chain + 1)
+                    params, _ = call(params, base_key, ids)
+                jax.block_until_ready(params)
+                jax.profiler.stop_trace()
+            attribution.write_capture_meta(profile_dir, {
+                "rounds": p_blocks * chain,
+                "backend": jax.default_backend(),
+                "source": "bench --profile_rounds"})
+            log(f"[bench]{label} profiled {p_blocks * chain} extra rounds "
+                f"-> {profile_dir}")
         return params, rounds_per_sec, compile_s, cache_info
 
-    params, rounds_per_sec, compile_s, cache_info = measure(cfg)
+    params, rounds_per_sec, compile_s, cache_info = measure(
+        cfg, profile_dir=(args.profile_trace_dir
+                          if args.profile_rounds > 0 else None))
+
+    # device-time attribution of the profiled window + HBM watermarks
+    # (obs/attribution.py) — the fields the run report and BENCH_NOTES r7
+    # judge; hbm is polled regardless of profiling (None-stats backends
+    # simply omit it)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        attribution as obs_attribution)
+    attribution_out = None
+    if args.profile_rounds > 0:
+        attribution_out = obs_attribution.attribute(args.profile_trace_dir)
+        if attribution_out is not None and \
+                attribution_out.get("device_present"):
+            log(f"[bench] attribution: "
+                f"{attribution_out['compute_ms']:.1f} ms compute | "
+                f"{attribution_out['collective_ms']:.1f} ms collective "
+                f"({100 * attribution_out['collective_frac']:.1f}%) | "
+                f"{attribution_out['gap_ms']:.1f} ms gap")
+        elif attribution_out is not None:
+            log(f"[bench] attribution: "
+                f"{attribution_out.get('note', 'no device track')}")
+    hbm = obs_attribution.memory_watermarks()
 
     faults_out = None
     if args.faults:
@@ -578,6 +643,10 @@ def main():
         out["faults"] = faults_out
     if telemetry_out is not None:
         out["telemetry"] = telemetry_out
+    if attribution_out is not None:
+        out["attribution"] = attribution_out
+    if hbm:
+        out["hbm"] = hbm
     # per-phase span aggregates (obs/spans.py): where this bench's wall
     # time actually went — probe vs data vs acquire vs blocks
     out["spans"] = tracer.aggregates()

@@ -232,6 +232,33 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         sharded=True, cfg_overrides={"chain": 2, "snap": 2},
         collective_budget={**zero, "psum": 2 * n_leaves + 2},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # --telemetry full families (ROADMAP REMAINING after PR 4): full
+    # telemetry's vote-margin histogram needs the per-leaf sign psums the
+    # RLR vote already issues — obs/telemetry.compute_sharded now takes
+    # them as `sign_sums` (the PR-4 shared-psum fix applied to the
+    # duplicate telemetry used to rely on XLA CSE'ing away, which
+    # channel-id'd all-reduces never do). Net telemetry cost on every
+    # sharded family: ZERO extra psums + exactly 3 tiny all_gathers
+    # (norms, cosine dots, cosine usq).
+    specs["vmap_rlr_avg_tel_full"] = CheckSpec(
+        name="vmap_rlr_avg_tel_full", family="round", sharded=False,
+        cfg_overrides={"telemetry": "full"},
+        collective_budget=dict(zero))
+    specs["sharded_rlr_avg_tel_full"] = CheckSpec(
+        name="sharded_rlr_avg_tel_full", family="round_sharded",
+        sharded=True, cfg_overrides={"telemetry": "full"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 3},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_sign_tel_full"] = CheckSpec(
+        name="sharded_rlr_sign_tel_full", family="round_sharded",
+        sharded=True,
+        cfg_overrides={"aggr": "sign", "server_lr": 1.0,
+                       "telemetry": "full"},
+        collective_budget={**zero, "psum": n_leaves + 1,
+                           "all_gather": 3},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
     return specs
 
 

@@ -148,6 +148,13 @@ class Config:
     resume: bool = False
     eval_bs: int = 1024
     profile_dir: str = ""           # "" disables jax.profiler traces
+    profile_rounds: int = 0         # >0: capture a jax.profiler window of
+                                    # this many STEADY rounds (never the
+                                    # compile unit) into <run_dir>/profile
+                                    # (or --profile_dir), parse it into
+                                    # Device/* + Memory/* attribution rows
+                                    # (obs/attribution.py) and the run
+                                    # report; 0 = off, bit-identical
     use_pallas: bool = False        # fused RLR+aggregate TPU kernel
     debug_nan: bool = False         # checkify float guards in the round fn
     diagnostics: bool = False       # Norms/* + Sign/* research scalars (C13)
@@ -280,6 +287,8 @@ FIELD_PROVENANCE = {
     "resume": "runtime",
     "eval_bs": "shape",           # eval batch geometry via pad_eval_set
     "profile_dir": "runtime",
+    "profile_rounds": "runtime",  # sampled profiler window; observation
+                                  # only, never shapes the program
     "use_pallas": "program",
     "debug_nan": "program",       # checkify instruments the program (AOT
                                   # bank is off, but the XLA cache is not)
@@ -454,6 +463,11 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--eval_bs", type=int, default=d.eval_bs)
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
+    p.add_argument("--profile_rounds", type=int, default=d.profile_rounds,
+                   help=">0: sample a jax.profiler capture window of this "
+                        "many steady rounds and attribute device time "
+                        "(obs/attribution.py: Device/* + Memory/* rows, "
+                        "run report input); 0 = off")
     p.add_argument("--use_pallas", action="store_true")
     p.add_argument("--debug_nan", action="store_true",
                    help="instrument the round program with checkify float "

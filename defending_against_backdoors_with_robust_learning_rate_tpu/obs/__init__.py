@@ -14,9 +14,22 @@ Three layers, built to be cheap enough to leave on:
                    `--telemetry off|basic|full`. `off` leaves the traced
                    program untouched: training is bit-identical.
 - `obs.heartbeat`  an atomically-rewritten `status.json` (phase, round,
-                   last span, compile-in-flight flag, PID) that
-                   `scripts/tpu_watch.sh` and the session stall detector
-                   consume instead of parsing stderr growth.
+                   last span, compile-in-flight flag, PID, HBM live/peak
+                   watermarks) that `scripts/tpu_watch.sh` and the
+                   session stall detector consume instead of parsing
+                   stderr growth.
+- `obs.attribution` device-time attribution from `jax.profiler` traces:
+                   the `--profile_rounds` sampled capture window, the
+                   shared Chrome-trace parser (compute vs collective vs
+                   gap, per program family and per `jax.named_scope`),
+                   and the `device.memory_stats()` watermarks — rows in
+                   metrics.jsonl (`Device/*`, `Memory/*`), fields in the
+                   bench JSON, and the input of `obs.report`.
+- `obs.report`     the run-report generator (`python -m ...obs.report
+                   <run_dir>`): report.md/report.json with the host-vs-
+                   device span table, collective share per family and
+                   memory watermarks, PASS/FAIL-gated against the pinned
+                   `obs_baseline.json` budgets.
 """
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs.heartbeat import (  # noqa: F401

@@ -186,13 +186,19 @@ def compute(cfg, updates, lr, agg, mask=None, corrupt_flags=None):
 # --- sharded (shard_map) path --------------------------------------------
 
 def compute_sharded(cfg, updates_local, lr, agg, axis_name,
-                    mask_local=None, mask_full=None, corrupt_full=None):
+                    mask_local=None, mask_full=None, corrupt_full=None,
+                    sign_sums=None):
     """Telemetry dict inside the shard_mapped round body. `updates_local`
     leaves are this device's [m/d, ...] agent block; `lr`/`agg` are
-    replicated trees. Collective cost: one tiny [m/d]->[m] all_gather for
-    the norms (plus one for the cosine numerators under ``full``) and
-    per-leaf psums of the sign sums the RLR vote already computes — XLA's
-    CSE folds the duplicates."""
+    replicated trees. Collective cost: three tiny all_gathers under
+    ``full`` (norms + the two cosine accumulators) and ZERO extra psums
+    when the caller hands over `sign_sums` — the RLR vote's per-leaf psum
+    results (raw or absolute; the margins take |s| either way). The
+    pre-PR-5 version issued its own textually-identical psums and relied
+    on XLA CSE, which the jaxpr contract checker measured never happens
+    across channel-id'd all-reduces (the same finding the vote/aggregate
+    sharing fixed in PR 4). Without `sign_sums` (RLR off) the psums are
+    issued here and budgeted accordingly."""
     with jax.named_scope("telemetry"):
         m = cfg.agents_per_round
         if mask_local is not None:
@@ -211,13 +217,19 @@ def compute_sharded(cfg, updates_local, lr, agg, axis_name,
         margin_sum = jnp.float32(0.0)
         dots_l = jnp.zeros((mb,), jnp.float32)
         usq_l = jnp.zeros((mb,), jnp.float32)
-        for u, a in zip(jax.tree_util.tree_leaves(updates_local),
-                        jax.tree_util.tree_leaves(agg), strict=True):
+        sign_leaves = (None if sign_sums is None
+                       else jax.tree_util.tree_leaves(sign_sums))
+        for i, (u, a) in enumerate(zip(
+                jax.tree_util.tree_leaves(updates_local),
+                jax.tree_util.tree_leaves(agg), strict=True)):
             uf = u.reshape(mb, -1).astype(jnp.float32)
             af = a.reshape(-1).astype(jnp.float32)
-            # same psum the sharded RLR vote issues -> CSE'd when RLR is on
-            s = jnp.abs(jax.lax.psum(jnp.sum(jnp.sign(uf), axis=0),
-                                     axis_name))
+            if sign_leaves is not None:
+                # the vote's own psum result, re-read — no new collective
+                s = jnp.abs(sign_leaves[i].reshape(-1))
+            else:
+                s = jnp.abs(jax.lax.psum(jnp.sum(jnp.sign(uf), axis=0),
+                                         axis_name))
             c, ms = _bucketize_margins(s, m)
             counts, margin_sum = counts + c, margin_sum + ms
             dots_l = dots_l + uf @ af

@@ -209,9 +209,11 @@ def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
     all-reduces where the plan promises 12 (analysis_baseline.json,
     sharded_rlr_sign). Sharing the collective here makes the documented
     budget true by construction; values are bit-identical (same
-    arithmetic, same order). Returns (lr_tree, agg_tree) with server
-    noise + empty-electorate guard applied, mirroring
-    _sharded_aggregate's tail."""
+    arithmetic, same order). Returns (lr_tree, agg_tree, sign_sums_tree)
+    with server noise + empty-electorate guard applied, mirroring
+    _sharded_aggregate's tail; `sign_sums` is the raw per-leaf psum
+    result, handed to full telemetry so its vote-margin histogram reads
+    the SAME collective instead of issuing a third copy per leaf."""
     thr = float(cfg.robustLR_threshold)
     if mask_local is not None:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
@@ -220,14 +222,16 @@ def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
         thr = masking.rlr_threshold(cfg, mask_full)
     slr = cfg.effective_server_lr
     leaves, treedef = jax.tree_util.tree_flatten(updates)
-    lr_leaves, agg_leaves = [], []
+    lr_leaves, agg_leaves, s_leaves = [], [], []
     for u in leaves:
         s = jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS)
         lr_leaves.append(jnp.where(jnp.abs(s) >= thr, slr,
                                    -slr).astype(jnp.float32))
         agg_leaves.append(jnp.sign(s))
+        s_leaves.append(s)
     lr = jax.tree_util.tree_unflatten(treedef, lr_leaves)
     agg = jax.tree_util.tree_unflatten(treedef, agg_leaves)
+    sign_sums = jax.tree_util.tree_unflatten(treedef, s_leaves)
     if cfg.noise > 0:
         agg = tree.add(agg, gaussian_noise_like(agg, noise_key,
                                                 cfg.noise * cfg.clip))
@@ -235,13 +239,17 @@ def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
             masking)
         agg = masking.guard_empty(agg, mask_full)
-    return lr, agg
+    return lr, agg, sign_sums
 
 
 def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None):
     """RLR sign-agreement vote as a psum (src/aggregation.py:48-54 semantics,
     vote over exactly the m sampled agents — minus masked-out voters on the
-    faults path, where the threshold may also scale with the electorate)."""
+    faults path, where the threshold may also scale with the electorate).
+    Returns (lr_tree, abs_sign_sums_tree): the |psum| the vote thresholds
+    is also exactly the margin full telemetry histograms, so handing it
+    out keeps telemetry's collective count at zero extra psums (the same
+    sharing `_sharded_sign_shared` does for the sign aggregate)."""
     thr = float(cfg.robustLR_threshold)
     if mask_local is not None:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
@@ -249,11 +257,14 @@ def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None):
         updates = masking.zero_masked(updates, mask_local)
         thr = masking.rlr_threshold(cfg, mask_full)
     slr = cfg.effective_server_lr
-
-    def leaf(u):
+    leaves, treedef = jax.tree_util.tree_flatten(updates)
+    lr_leaves, s_leaves = [], []
+    for u in leaves:
         s = jnp.abs(jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS))
-        return jnp.where(s >= thr, slr, -slr).astype(jnp.float32)
-    return tree.map(leaf, updates)
+        lr_leaves.append(jnp.where(s >= thr, slr, -slr).astype(jnp.float32))
+        s_leaves.append(s)
+    return (jax.tree_util.tree_unflatten(treedef, lr_leaves),
+            jax.tree_util.tree_unflatten(treedef, s_leaves))
 
 
 def _sharded_pallas_apply(params, updates, sizes, cfg):
@@ -354,16 +365,18 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
             return new_params, loss, {}
+        sign_sums = None
         with jax.named_scope("aggregate_rlr"):
             if cfg.robustLR_threshold > 0 and cfg.aggr == "sign":
                 # vote + aggregate share one sign-sum psum per leaf (the
                 # CSE XLA was measured not to do — see _sharded_sign_shared)
-                lr, agg = _sharded_sign_shared(updates, cfg, noise_key,
-                                               mask_local, mask_full)
+                lr, agg, sign_sums = _sharded_sign_shared(
+                    updates, cfg, noise_key, mask_local, mask_full)
             else:
                 if cfg.robustLR_threshold > 0:
-                    lr = _sharded_robust_lr(updates, cfg, mask_local,
-                                            mask_full)
+                    lr, sign_sums = _sharded_robust_lr(updates, cfg,
+                                                       mask_local,
+                                                       mask_full)
                 else:
                     lr = cfg.effective_server_lr
                 agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
@@ -376,11 +389,14 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
         if cfg.telemetry != "off":
             from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
                 telemetry)
+            # sign_sums: the vote's per-leaf psum results, so full
+            # telemetry's margin histogram re-reads the existing
+            # collective instead of duplicating it per leaf
             extras.update(telemetry.compute_sharded(
                 cfg, updates,
                 lr if cfg.robustLR_threshold > 0 else None, agg,
                 AGENTS_AXIS, mask_local=mask_local, mask_full=mask_full,
-                corrupt_full=corrupt_full))
+                corrupt_full=corrupt_full, sign_sums=sign_sums))
         if cfg.diagnostics:
             from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
                 per_agent_norms)

@@ -30,7 +30,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate impor
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     FAULT_INFO_KEYS, host_takes_flags, make_round_fn, make_round_fn_host)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
-    Heartbeat, NullHeartbeat, SpanTracer, telemetry as obs_telemetry)
+    Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
+    telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -535,7 +536,18 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             eval_pval_fn = fn
 
 
-    if cfg.profile_dir and lead:
+    # sampled device-trace window (--profile_rounds N, obs/attribution.py):
+    # opens at the first STEADY dispatch unit (never the compile unit),
+    # closes after N rounds, and is parsed into Device/* + Memory/*
+    # attribution rows after the loop. A bare --profile_dir (without
+    # --profile_rounds) keeps its historical whole-run trace semantics.
+    prof = None
+    if cfg.profile_rounds > 0 and lead:
+        run_dir_hint = getattr(writer, "dir", None) or cfg.log_dir
+        prof = obs_attribution.RoundProfiler(
+            cfg.profile_rounds,
+            cfg.profile_dir or os.path.join(run_dir_hint, "profile"))
+    if cfg.profile_dir and lead and prof is None:
         jax.profiler.start_trace(cfg.profile_dir)
 
     # --- async metrics pipeline: per-round/eval scalars stay on device and
@@ -642,6 +654,10 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
     try:
         for unit in units:
             hb.update(phase="train", round=unit[-1])
+            if prof is not None and not first_unit:
+                # steady state: every hot-path program compiled during the
+                # first unit, so the window never captures XLA working
+                prof.maybe_start()
             if len(unit) > 1:
                 # chained block: fixed length => one compilation per shape
                 with tracer.span("round/data_prep", round=unit[-1]):
@@ -682,6 +698,11 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                         params, info = (diag_round_fn if want_diag
                                         else round_fn)(params, key)
                 rounds_done += 1
+            if prof is not None:
+                # accounts the unit toward the capture budget and polls
+                # the HBM watermarks; closes the window (blocking on
+                # params first) once the budget is reached
+                prof.after_unit(params, len(unit))
 
             if want_diag:
                 if "agent_norms" in info:
@@ -710,7 +731,11 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
                         writer.scalar(tag, v, rnd)
 
             if rnd % cfg.snap == 0:
-                hb.update(phase="eval", round=rnd)
+                # HBM watermarks ride the heartbeat so the session stall
+                # detectors see memory pressure, not just phase ({} on
+                # backends without allocator stats)
+                hb.update(phase="eval", round=rnd,
+                          **obs_attribution.memory_watermarks())
                 # divergence aborts only under --debug_nan (sync mode);
                 # otherwise the finite check rides the drain and warns,
                 # and the run keeps recording its (NaN) metrics
@@ -779,8 +804,11 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             drain.close(raise_errors=False)
         if prefetcher is not None:
             prefetcher.close()
+        if prof is not None:
+            # a run shorter than the budget still flushes its window
+            prof.close(params)
 
-    if cfg.profile_dir and lead:
+    if cfg.profile_dir and lead and prof is None:
         jax.profiler.stop_trace()
 
     elapsed = time.perf_counter() - t_loop
@@ -798,6 +826,35 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
           f"({rounds_done} rounds in {elapsed:.1f}s)"
           + (f"; steady-state {summary['steady_rounds_per_sec']:.3f} r/s"
              if "steady_rounds_per_sec" in summary else ""))
+    # device-time attribution (obs/attribution.py): the sampled capture
+    # window parses into Device/* rows + the summary; HBM watermarks (the
+    # per-captured-unit maxima, plus a final poll) land as Memory/* rows
+    # and heartbeat fields. All of it is absent when --profile_rounds=0
+    # and the backend exposes no memory_stats — the off path emits nothing.
+    mem = obs_attribution.memory_watermarks()
+    if prof is not None:
+        for key, val in prof.mem.items():
+            mem[key] = max(mem.get(key, 0), val)
+        attr = prof.result()
+        if attr is not None:
+            for tag, v in obs_attribution.scalar_rows(attr):
+                writer.scalar(tag, v, rnd)
+            summary["attribution"] = attr
+            if attr.get("device_present"):
+                pr = attr.get("per_round", {})
+                print(f"[profile] device time/round: "
+                      f"{pr.get('compute_ms', 0.0):.1f} ms compute + "
+                      f"{pr.get('collective_ms', 0.0):.1f} ms collective "
+                      f"+ {pr.get('gap_ms', 0.0):.1f} ms gap "
+                      f"({100 * attr['collective_frac']:.1f}% collective)")
+            else:
+                print(f"[profile] {attr.get('note', 'no device track')}")
+    if mem:
+        # memory_rows values are host ints from device.memory_stats()
+        for tag, val in obs_attribution.memory_rows(mem):
+            writer.scalar(tag, val, rnd)
+        summary["memory"] = mem
+        hb.update(**mem)
     # per-span aggregates -> metrics.jsonl (Spans/*) and the summary; the
     # full event stream -> trace.json in the run dir (Perfetto-loadable)
     if tracer.enabled:

@@ -81,6 +81,8 @@ EXCLUDED_FIELDS = frozenset({
     # `host_sampled` (family names already key the fingerprint).
     "platform", "coordinator", "num_processes", "process_id", "top_frac",
     "rng_impl", "mesh", "host_sampled",
+    # sampled profiler window (obs/attribution.py): observation only
+    "profile_rounds",
 })
 
 # families built from cfg.replace(diagnostics=False) in the driver; their

@@ -120,9 +120,20 @@ else
 fi
 
 say "step 1/6: flagship TPU bench (re-land the r3 number; VERDICT next #2)"
-if run_bench logs/bench_r5_stdout.txt; then
+# --profile_rounds 3: after the timed blocks, capture a 3-round device
+# trace (obs/attribution.py) — BENCH_TPU_r05.json then carries the
+# compute/collective/gap + named-scope split and the HBM watermarks the
+# BENCH_NOTES r7 entry judges; the capture itself stays outside the
+# timed window, so the headline figure is untouched
+if run_bench logs/bench_r5_stdout.txt --profile_rounds 3 \
+        --profile_trace_dir logs/bench_profile; then
     tail -1 logs/bench_r5_stdout.txt > BENCH_TPU_r05.json
     say "bench: $(cat BENCH_TPU_r05.json)"
+    # op-level view of the same capture, for the BENCH_NOTES reconcile
+    python scripts/trace_top_ops.py --parse logs/bench_profile \
+        > logs/trace_top_ops_r5.txt 2>&1 \
+        && say "trace parse: logs/trace_top_ops_r5.txt" \
+        || say "WARN: trace parse failed (see logs/trace_top_ops_r5.txt)"
     SUCCESSES=$((SUCCESSES + 1))
 else
     say "WARN: bench rc=$? — see $LOG"

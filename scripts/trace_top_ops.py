@@ -9,21 +9,31 @@ attribution precision. This script is the other half: capture ONE op-level
 trace of steady-state rounds and print where XLA's own schedule says the
 time goes, so the two decompositions can be reconciled in BENCH_NOTES.md.
 
+Since the obs/ attribution layer landed, this is a thin CLI: the parsing
+lives in ``obs.attribution`` (`parse_top_ops` for this op-kind view,
+`attribute` for the compute/collective/gap + named-scope split the run
+report uses) — one parser, re-used by `python -m ..obs.report`, bench.py
+and the driver's `--profile_rounds` window.
+
 Usage:
   python scripts/trace_top_ops.py              # capture + parse (TPU)
   python scripts/trace_top_ops.py --parse DIR  # re-parse an existing trace
 """
 
 import argparse
-import collections
-import glob
-import gzip
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs.attribution import (  # noqa: E402
+    attribute, find_trace_file, group_name, load_trace_events, parse_top_ops)
+
+# historical names kept importable (tests/test_trace_tool.py and any
+# notebook that did `from trace_top_ops import parse`)
+parse = parse_top_ops
+__all__ = ["attribute", "capture", "group_name", "parse", "parse_top_ops"]
 
 
 def capture(trace_dir: str, rounds: int, platform: str = "",
@@ -42,6 +52,8 @@ def capture(trace_dir: str, rounds: int, platform: str = "",
         make_round_fn)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         get_model, init_params)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs.attribution import (
+        write_capture_meta)
     from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
         apply_rng_impl)
 
@@ -79,124 +91,16 @@ def capture(trace_dir: str, rounds: int, platform: str = "",
         params, _ = round_fn(params, jax.random.fold_in(base_key, r))
     jax.block_until_ready(params)
     jax.profiler.stop_trace()
-    with open(os.path.join(trace_dir, "capture_meta.json"), "w") as f:
-        json.dump({"rounds": rounds}, f)
+    write_capture_meta(trace_dir, {"rounds": rounds,
+                                   "backend": jax.default_backend(),
+                                   "source": "trace_top_ops"})
     print(f"[trace] captured {rounds} steady rounds -> {trace_dir}",
           flush=True)
 
 
-GROUP_RE = re.compile(r"(\.(\d+|remat\d*|clone))+$")
-
-
-def group_name(name: str) -> str:
-    """fusion.123 -> fusion; convolution.4.remat -> convolution (group HLO
-    instances of the same op kind, including remat/clone-suffixed copies)."""
-    base = GROUP_RE.sub("", name)
-    return base or name
-
-
-def parse(trace_dir: str, top: int, rounds: int):
-    paths = sorted(glob.glob(os.path.join(
-        trace_dir, "**", "*.trace.json.gz"), recursive=True))
-    if not paths:
-        sys.exit(f"no *.trace.json.gz under {trace_dir}")
-    meta = os.path.join(trace_dir, "capture_meta.json")
-    if os.path.exists(meta):
-        with open(meta) as f:
-            rounds = json.load(f)["rounds"]
-    else:
-        print(f"[trace] no capture_meta.json — assuming --rounds={rounds} "
-              f"for the ms/round figure")
-    chosen = max(paths, key=os.path.getmtime)
-    if len(paths) > 1:
-        # one .trace.json.gz per host per profiler run; on this one-host
-        # setup multiple files mean multiple capture runs — parse the
-        # newest and say so (merging across runs would mix programs)
-        print(f"[trace] {len(paths)} trace files under {trace_dir}; "
-              f"parsing the newest: {chosen}")
-    with gzip.open(chosen, "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
-    # chrome-trace metadata: pid -> process name, (pid, tid) -> thread
-    # name; device lanes are the /device:TPU:* (or TPU:*) processes, host
-    # threads are everything else
-    pnames, tnames = {}, {}
-    for e in events:
-        if e.get("ph") != "M":
-            continue
-        if e.get("name") == "process_name":
-            pnames[e["pid"]] = e.get("args", {}).get("name", "")
-        elif e.get("name") == "thread_name":
-            tnames[(e["pid"], e.get("tid"))] = \
-                e.get("args", {}).get("name", "")
-    dev_pids = {pid for pid, n in pnames.items()
-                if "tpu" in n.lower() or "/device" in n.lower()}
-    if not dev_pids:
-        print("[trace] NO device lanes in this trace (profiler saw only "
-              "host threads — the chip is behind the axon tunnel). "
-              f"Processes seen: {sorted(set(pnames.values()))}")
-        return None
-    # a device process exports several stacked lanes (e.g. an 'XLA Modules'
-    # envelope spanning the whole executable above per-op 'XLA Ops' rows,
-    # and often a 'TensorFlow Ops' framework-attribution lane covering the
-    # SAME device time); summing across all of them double-counts. Prefer
-    # the exact 'XLA Ops' lane(s); fall back to the substring heuristic
-    # only when no lane carries that name.
-    xla_tids = {(p, t) for (p, t), n in tnames.items()
-                if p in dev_pids and n.strip().lower() == "xla ops"}
-    op_tids = xla_tids or {(p, t) for (p, t), n in tnames.items()
-                           if p in dev_pids and "op" in n.lower()
-                           and "module" not in n.lower()}
-
-    def in_op_lane(e):
-        if (e["pid"], e.get("tid")) in op_tids:
-            return True
-        # no op-level lane metadata: fall back to excluding known
-        # envelope lanes by name
-        if not op_tids:
-            lane = tnames.get((e["pid"], e.get("tid")), "").lower()
-            return "module" not in lane and "step" not in lane
-        return False
-
-    per_op = collections.Counter()
-    per_group = collections.Counter()
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in dev_pids \
-                or not in_op_lane(e):
-            continue
-        dur = float(e.get("dur", 0.0))  # microseconds
-        name = e.get("name", "?")
-        per_op[name] += dur
-        per_group[group_name(name)] += dur
-        total += dur
-    if total == 0.0:
-        print("[trace] device lanes exist but no duration events matched "
-              f"the op-level filter; lanes: "
-              f"{sorted(set(tnames.values()))}")
-        return None
-    lanes = (sorted(tnames[t] for t in op_tids)
-             or "(fallback: all non-module lanes)")
-    print(f"[trace] device processes: "
-          f"{sorted(pnames[p] for p in dev_pids)}; op lanes: {lanes}")
-    print(f"[trace] total device-op time in window: {total/1e3:.1f} ms "
-          f"({rounds} rounds -> {total/1e3/max(rounds,1):.1f} ms/round)")
-    print(f"\ntop {top} op groups (device time, % of captured op time):")
-    rows = []
-    for name, dur in per_group.most_common(top):
-        print(f"  {name:<44s} {dur/1e3:8.1f} ms  {100*dur/total:5.1f}%")
-        rows.append({"op": name, "ms": round(dur / 1e3, 1),
-                     "pct": round(100 * dur / total, 1)})
-    print(f"\ntop {top} individual ops:")
-    for name, dur in per_op.most_common(top):
-        print(f"  {name:<44s} {dur/1e3:8.1f} ms  {100*dur/total:5.1f}%")
-    return {"total_ms": round(total / 1e3, 1), "rounds": rounds,
-            "top_groups": rows}
-
-
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--parse", default="",
+    ap.add_argument("--parse", default="", dest="parse_dir",
                     help="parse an existing trace dir instead of capturing")
     ap.add_argument("--trace_dir", default="/tmp/rlr_trace")
     ap.add_argument("--rounds", type=int, default=3,
@@ -208,10 +112,24 @@ def main():
                     help="tiny shapes — validates the capture->parse "
                          "pipeline without the full config")
     args = ap.parse_args()
-    tdir = args.parse or args.trace_dir
-    if not args.parse:
+    tdir = args.parse_dir or args.trace_dir
+    if not args.parse_dir:
         capture(tdir, args.rounds, args.platform, args.smoke)
-    parse(tdir, args.top, args.rounds)
+    # load the trace once — both views parse the same newest file, and a
+    # full-shape XLA:CPU capture runs to GBs (minutes per gunzip+load)
+    path = find_trace_file(tdir)
+    events = load_trace_events(path) if path else None
+    parse_top_ops(tdir, args.top, args.rounds, events=events)
+    # the attribution view of the same trace: compute vs collective vs gap
+    # and the named-scope split the run report renders
+    attr = attribute(tdir, events=events)
+    if attr and attr.get("device_present"):
+        print(f"\n[trace] attribution: compute {attr['compute_ms']:.1f} ms"
+              f" | collective {attr['collective_ms']:.1f} ms"
+              f" ({100 * attr['collective_frac']:.1f}%)"
+              f" | gap {attr['gap_ms']:.1f} ms")
+        print(f"[trace] by scope: "
+              f"{json.dumps(attr.get('by_scope_ms', {}))}")
 
 
 if __name__ == "__main__":

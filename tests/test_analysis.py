@@ -410,7 +410,7 @@ def test_sharded_collective_counts_match_pinned_baseline():
     with open(path) as f:
         pinned = json.load(f)
     for name in ("sharded_rlr_avg", "sharded_rlr_sign",
-                 "sharded_rlr_avg_faults"):
+                 "sharded_rlr_avg_faults", "sharded_rlr_sign_tel_full"):
         spec = contracts.check_specs()[name]
         findings, record = jaxpr_lint.check_family(spec)
         assert findings == [], findings
@@ -427,6 +427,32 @@ def test_sign_vote_psum_sharing():
         contracts.check_specs()["sharded_rlr_sign"])
     n_leaves = 8
     assert record["collectives"]["psum"] == n_leaves + 1
+
+
+def test_telemetry_full_shares_the_vote_psums():
+    """ISSUE-5 satellite: the --telemetry full families are in the
+    checked matrix, and full telemetry adds ZERO psums (its vote-margin
+    histogram reads the RLR vote's own sign psums via `sign_sums`) plus
+    exactly 3 tiny all_gathers (norms + the two cosine accumulators)."""
+    specs = contracts.check_specs()
+    _, plain_avg = jaxpr_lint.check_family(specs["sharded_rlr_avg"])
+    f, tel_avg = jaxpr_lint.check_family(specs["sharded_rlr_avg_tel_full"])
+    assert f == []
+    assert tel_avg["collectives"]["psum"] == \
+        plain_avg["collectives"]["psum"]
+    assert tel_avg["collectives"]["all_gather"] == 3
+
+    _, plain_sign = jaxpr_lint.check_family(specs["sharded_rlr_sign"])
+    f, tel_sign = jaxpr_lint.check_family(
+        specs["sharded_rlr_sign_tel_full"])
+    assert f == []
+    assert tel_sign["collectives"]["psum"] == \
+        plain_sign["collectives"]["psum"]   # still n_leaves + 1, shared
+    assert tel_sign["collectives"]["all_gather"] == 3
+
+    # the vmap path stays collective-free even at full telemetry
+    f, rec = jaxpr_lint.check_family(specs["vmap_rlr_avg_tel_full"])
+    assert f == [] and rec["collectives"] == {}
 
 
 def test_faults_adds_exactly_one_all_gather():

@@ -1,0 +1,146 @@
+"""Device-time attribution parser (obs/attribution.py) on the committed
+fixture trace (ISSUE 5 satellite): attribution totals, named-scope
+correlation, graceful handling of traces with no device track (XLA:CPU),
+and parity with the scripts/trace_top_ops.py CLI the parser absorbed."""
+
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    attribution)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(ROOT, "tests", "data", "fixture_trace")
+
+
+def test_fixture_attribution_totals():
+    """Exact split on the committed fixture: only the 'XLA Ops' lane is
+    summed (module envelope + framework lane + host threads excluded),
+    collectives classified by op group, gap = window - busy."""
+    attr = attribution.attribute(FIXTURE)
+    assert attr["device_present"] is True
+    assert attr["devices"] == ["/device:TPU:0"]
+    assert attr["backend"] == "tpu"
+    assert attr["rounds"] == 2            # from capture_meta.json
+    assert attr["busy_ms"] == pytest.approx(8.2)
+    assert attr["compute_ms"] == pytest.approx(7.0)
+    assert attr["collective_ms"] == pytest.approx(1.2)   # all-reduce+gather
+    assert attr["window_ms"] == pytest.approx(9.0)
+    assert attr["gap_ms"] == pytest.approx(0.8)
+    assert attr["collective_frac"] == pytest.approx(1.2 / 8.2, abs=1e-3)
+    assert attr["per_round"]["busy_ms"] == pytest.approx(4.1)
+
+
+def test_fixture_scope_correlation():
+    """XLA ops correlate back to the jax.named_scope annotations planted
+    in fl/rounds.py + parallel/rounds.py via the op_name metadata path."""
+    attr = attribution.attribute(FIXTURE)
+    assert attr["by_scope_ms"] == {
+        "local_train": pytest.approx(5.0),
+        "aggregate_rlr": pytest.approx(1.3),
+        "telemetry": pytest.approx(0.4),
+        "sample_gather": pytest.approx(0.3),
+        "unscoped": pytest.approx(1.2),
+    }
+    # per-program-family split: the eval module carries no collectives
+    assert attr["by_program"]["jit_eval"]["collective_ms"] == 0.0
+    assert attr["by_program"]["jit_step"]["collective_ms"] == \
+        pytest.approx(1.2)
+
+
+def test_fixture_scalar_rows():
+    rows = dict(attribution.scalar_rows(attribution.attribute(FIXTURE)))
+    assert rows["Device/Collective_Frac"] == pytest.approx(0.1463,
+                                                           abs=1e-3)
+    assert rows["Device/Busy_Ms_Per_Round"] == pytest.approx(4.1)
+    assert rows["Device/Scope/local_train_Ms_Per_Round"] == \
+        pytest.approx(2.5)
+
+
+def _write_trace(tmp_path, events):
+    os.makedirs(tmp_path / "plugins" / "profile", exist_ok=True)
+    p = tmp_path / "plugins" / "profile" / "host.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def test_no_device_track_is_graceful(tmp_path):
+    """An XLA:CPU capture has no /device:* process: attribute() must
+    return device_present=False with a note, not crash — the CPU driver
+    smoke and the CI report run ride this path."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 1,
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "PjitFunction(step)",
+         "ts": 1.0, "dur": 5.0},
+    ]
+    attr = attribution.attribute(_write_trace(tmp_path, events))
+    assert attr["device_present"] is False
+    assert "no device lanes" in attr["note"]
+    assert attribution.scalar_rows(attr) == []
+
+
+def test_empty_dir_returns_none(tmp_path):
+    assert attribution.attribute(str(tmp_path)) is None
+
+
+def test_trace_top_ops_cli_delegates_to_shared_parser(capsys):
+    """Acceptance: scripts/trace_top_ops.py output is reproduced by the
+    shared parser on the same trace — the script's `parse` IS
+    attribution.parse_top_ops, and the figures agree with attribute()."""
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import trace_top_ops
+    finally:
+        sys.path.pop(0)
+    assert trace_top_ops.parse is attribution.parse_top_ops
+    assert trace_top_ops.group_name is attribution.group_name
+    out = trace_top_ops.parse(FIXTURE, top=5, rounds=99)
+    assert out["rounds"] == 2              # capture_meta wins over the CLI
+    attr = attribution.attribute(FIXTURE)
+    assert out["total_ms"] == pytest.approx(attr["busy_ms"], abs=0.05)
+    top = {r["op"]: r["ms"] for r in out["top_groups"]}
+    assert top["convolution"] == pytest.approx(3.0)  # remat suffix grouped
+    assert "fusion" in top
+
+
+def test_memory_watermarks_maps_allocator_stats():
+    class Dev:
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "peak_bytes_in_use": 20,
+                    "num_allocs": 3}
+
+    class NoStats:
+        def memory_stats(self):
+            return None
+
+    class Raises:
+        def memory_stats(self):
+            raise RuntimeError("not supported")
+
+    assert attribution.memory_watermarks(Dev()) == {
+        "hbm_live_bytes": 10, "hbm_peak_bytes": 20}
+    assert attribution.memory_watermarks(NoStats()) == {}
+    assert attribution.memory_watermarks(Raises()) == {}
+    assert dict(attribution.memory_rows(
+        {"hbm_live_bytes": 10, "hbm_peak_bytes": 20})) == {
+        "Memory/HBM_Live_Bytes": 10.0, "Memory/HBM_Peak_Bytes": 20.0}
+
+
+def test_round_profiler_off_never_opens_a_window(tmp_path):
+    """--profile_rounds 0 (the default) constructs nothing: no trace dir,
+    no jax.profiler call — the bit-identity contract's structural half."""
+    prof = attribution.RoundProfiler(0, str(tmp_path / "never"))
+    assert not prof.enabled and prof.done
+    prof.maybe_start()
+    prof.after_unit(None, 1)
+    prof.close()
+    assert not os.path.exists(str(tmp_path / "never"))
+    assert prof.result() is None

@@ -250,6 +250,22 @@ def test_telemetry_sharded_matches_vmap():
                                    np.asarray(ref[key]), rtol=1e-5,
                                    atol=1e-6, err_msg=key)
 
+    # the shared-psum path (ISSUE 5): handing the vote's sign sums in as
+    # `sign_sums` must reproduce the self-psum'd margins bit-for-bit —
+    # that is what makes the zero-extra-psum contract safe to enforce
+    sums = {"w": jnp.abs(jnp.sum(jnp.sign(updates["w"]), axis=0))}
+    f2 = shard_map(
+        lambda u, a, c, s: telemetry.compute_sharded(
+            cfg, u, None, a, AGENTS_AXIS, corrupt_full=c, sign_sums=s),
+        mesh=mesh, in_specs=(P(AGENTS_AXIS), P(), P(), P()),
+        out_specs={key: P() for key in telemetry.telemetry_keys(cfg)},
+        check_vma=False)
+    shared = f2(updates, agg, flags, sums)
+    for key in ("tel_margin_hist", "tel_margin_mean"):
+        np.testing.assert_array_equal(np.asarray(shared[key]),
+                                      np.asarray(sharded[key]),
+                                      err_msg=key)
+
 
 # --- telemetry: round-fn bit-identity ------------------------------------
 
@@ -365,6 +381,78 @@ def test_driver_telemetry_sync_async_defense_parity(tmp_path):
     ra = records("async")
     rs = records("sync", async_metrics=False)
     assert ra == rs and len(ra) >= 2 * 4  # >=4 Defense rows per boundary
+
+
+def test_driver_profile_rounds_window_report_and_off_bit_identity(
+        tmp_path, monkeypatch):
+    """ISSUE-5 acceptance, driver side: --profile_rounds 2 samples a
+    steady capture window (trace + capture_meta under <run_dir>/profile),
+    degrades gracefully on XLA:CPU (no device track), feeds the
+    heartbeat the HBM watermarks, and the run report renders from the
+    run dir — while the default --profile_rounds 0 stream stays
+    bit-identical (every non-timing metrics row equal)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu import train
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        attribution, report)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        MetricsWriter, run_name)
+
+    # fake allocator stats: XLA:CPU has none, but the watermark plumbing
+    # (per-captured-unit polling -> Memory/* rows + heartbeat fields)
+    # must be exercised in tier-1, not first on a TPU session
+    monkeypatch.setattr(attribution, "memory_watermarks",
+                        lambda device=None: {"hbm_live_bytes": 1000,
+                                             "hbm_peak_bytes": 2000})
+
+    def run(mode_dir, **kw):
+        cfg = SMOKE.replace(log_dir=str(tmp_path / mode_dir),
+                            compile_cache_dir=str(tmp_path / "cache"),
+                            rounds=4, snap=2, **kw)
+        writer = MetricsWriter(cfg.log_dir, run_name(cfg),
+                               tensorboard=False)
+        summary = train.run(cfg, writer=writer)
+        return cfg, writer.dir, summary
+
+    cfg, run_dir, summary = run("prof", profile_rounds=2)
+    # the window captured 2 steady rounds (units 2..3; never the compile)
+    meta = json.load(open(os.path.join(run_dir, "profile",
+                                       "capture_meta.json")))
+    assert meta["rounds"] == 2 and meta["backend"] == "cpu"
+    assert attribution.find_trace_file(
+        os.path.join(run_dir, "profile")) is not None
+    # XLA:CPU: no device track, said so instead of fake numbers
+    assert summary["attribution"]["device_present"] is False
+    # memory watermarks: summary + Memory/* rows + heartbeat fields
+    assert summary["memory"]["hbm_peak_bytes"] == 2000
+    tags = {r["tag"] for r in _tags(os.path.join(run_dir,
+                                                 "metrics.jsonl"))}
+    assert {"Memory/HBM_Live_Bytes", "Memory/HBM_Peak_Bytes"} <= tags
+    status = json.load(open(os.path.join(cfg.log_dir, "status.json")))
+    assert status["hbm_peak_bytes"] == 2000
+
+    # the run report renders from the run dir and passes the repo pins
+    assert report.main([run_dir, "--backend", "cpu"]) == 0
+    assert os.path.exists(os.path.join(run_dir, "report.md"))
+    doc = json.load(open(os.path.join(run_dir, "report.json")))
+    assert doc["attribution"]["device_present"] is False
+
+    # default-off run: no capture dir, no Device/* rows (Memory rows stay
+    # — the watermark poll is backend-gated, not profile-gated), and
+    # every value-carrying row equal to the profiled run's
+    _, off_dir, off_summary = run("off")
+    assert "attribution" not in off_summary
+    assert not os.path.exists(os.path.join(off_dir, "profile"))
+    off_tags = {r["tag"] for r in _tags(os.path.join(off_dir,
+                                                     "metrics.jsonl"))}
+    assert not any(t.startswith("Device/") for t in off_tags)
+
+    def value_rows(d):
+        skip = ("Spans/", "Throughput/", "Device/", "Memory/", "_run/")
+        return [r for r in _tags(os.path.join(d, "metrics.jsonl"))
+                if not any(r["tag"].startswith(p) for p in skip)]
+
+    prof_rows = value_rows(run_dir)
+    assert prof_rows == value_rows(off_dir) and len(prof_rows) >= 2 * 7
 
 
 def test_run_name_distinguishes_fault_sweep_cells():

@@ -1,0 +1,155 @@
+"""Run-report generator (obs/report.py): report.md/report.json emission,
+the host-vs-device span table, budget PASS/FAIL against obs_baseline.json
+(ISSUE 5 acceptance: exits non-zero on an artificially tightened budget),
+and the --write-baseline refresh workflow."""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    report)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(ROOT, "tests", "data", "fixture_trace")
+
+ROWS = [
+    {"tag": "_run/start", "value": 1.0, "step": -1},
+    # a stale earlier segment that must be ignored
+    {"tag": "Spans/round/dispatch/p50_ms", "value": 9e9, "step": 4},
+    {"tag": "_run/start", "value": 2.0, "step": -1},
+    {"tag": "Validation/Accuracy", "value": 0.9, "step": 4},
+    {"tag": "Throughput/Rounds_Per_Sec", "value": 5.0, "step": 4},
+    {"tag": "Spans/round/dispatch/count", "value": 4.0, "step": 4},
+    {"tag": "Spans/round/dispatch/p50_ms", "value": 12.0, "step": 4},
+    {"tag": "Spans/round/dispatch/p95_ms", "value": 30.0, "step": 4},
+    {"tag": "Spans/round/dispatch/total_s", "value": 0.2, "step": 4},
+    {"tag": "Spans/round/dispatch/max_ms", "value": 33.0, "step": 4},
+    {"tag": "Spans/metrics/emit/p50_ms", "value": 1.5, "step": 4},
+    # a count ending in 0: integer rendering must not strip it to "2"
+    {"tag": "Spans/metrics/emit/count", "value": 20.0, "step": 4},
+    {"tag": "Memory/HBM_Peak_Bytes", "value": 123456.0, "step": 4},
+]
+
+
+def _run_dir(tmp_path, with_profile=True, rows=ROWS):
+    run = tmp_path / "run"
+    os.makedirs(run, exist_ok=True)
+    with open(run / "metrics.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    if with_profile:
+        shutil.copytree(FIXTURE, run / "profile")
+    return str(run)
+
+
+def test_report_emits_md_and_json_with_host_device_table(tmp_path):
+    run = _run_dir(tmp_path)
+    rc = report.main([run, "--baseline", str(tmp_path / "none.json")])
+    assert rc == 0
+    md = open(os.path.join(run, "report.md")).read()
+    doc = json.load(open(os.path.join(run, "report.json")))
+    # host columns + the device ms/round column, side by side
+    assert "| span | count | host p50 ms" in md
+    assert "device ms/round" in md
+    assert "`round/dispatch` | 4 | 12" in md
+    assert "`metrics/emit` | 20 |" in md
+    # the device side comes from the fixture capture (4.1 ms/round)
+    assert "4.1" in md
+    # collective share per program family + memory section
+    assert "jit_step" in md and "Collective share" in md
+    assert "123,456 bytes" in md
+    # named-scope attribution table
+    assert "`local_train`" in md and "`aggregate_rlr`" in md
+    assert doc["backend"] == "tpu"      # inferred from the capture meta
+    assert doc["attribution"]["device_present"] is True
+    assert doc["pass"] is True
+    # only the LAST run segment of metrics.jsonl is judged
+    assert doc["spans"]["round/dispatch"]["p50_ms"] == 12.0
+
+
+def test_report_no_profile_dir_degrades_to_host_only(tmp_path):
+    run = _run_dir(tmp_path, with_profile=False)
+    rc = report.main([run, "--baseline", str(tmp_path / "none.json")])
+    assert rc == 0
+    md = open(os.path.join(run, "report.md")).read()
+    assert "No profiler capture found" in md
+    doc = json.load(open(os.path.join(run, "report.json")))
+    assert doc["backend"] == "cpu" and doc["attribution"] is None
+
+
+def test_report_budget_pass_and_tightened_fail(tmp_path):
+    """The acceptance pin: a budget within tolerance passes (rc 0), an
+    artificially tightened one fails (rc 1) with the violation named."""
+    run = _run_dir(tmp_path)
+    bl = tmp_path / "obs_baseline.json"
+    bl.write_text(json.dumps({
+        "tolerance": 1.5,
+        "budgets": {"tpu": {
+            "Spans/round/dispatch/p50_ms": {"max": 10.0},  # 12 <= 15 ok
+        }}}))
+    assert report.main([run, "--baseline", str(bl)]) == 0
+
+    bl.write_text(json.dumps({
+        "tolerance": 1.5,
+        "budgets": {"tpu": {
+            "Spans/round/dispatch/p50_ms": {"max": 1.0},   # 12 > 1.5
+        }}}))
+    assert report.main([run, "--baseline", str(bl)]) == 1
+    doc = json.load(open(os.path.join(run, "report.json")))
+    assert doc["pass"] is False
+    bad = [r for r in doc["budget_results"] if not r["pass"]]
+    assert bad[0]["metric"] == "Spans/round/dispatch/p50_ms"
+    assert "FAIL" in open(os.path.join(run, "report.md")).read()
+
+
+def test_report_missing_pinned_metric_fails(tmp_path):
+    """Silently losing a pinned span is a regression: missing metric =>
+    FAIL, not skip."""
+    run = _run_dir(tmp_path)
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "budgets": {"tpu": {"Spans/gone/p50_ms": {"max": 1.0}}}}))
+    assert report.main([run, "--baseline", str(bl)]) == 1
+    doc = json.load(open(os.path.join(run, "report.json")))
+    assert doc["budget_results"][0]["note"] == "metric missing from the run"
+
+
+def test_write_baseline_roundtrip(tmp_path):
+    """--write-baseline pins measured*headroom for the default metrics
+    present in the run; a rerun against the fresh pins passes."""
+    run = _run_dir(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    rc = report.main([run, "--baseline", bl, "--write-baseline",
+                      "--headroom", "4.0"])
+    assert rc == 0
+    pinned = json.load(open(bl))
+    sec = pinned["budgets"]["tpu"]
+    assert sec["Spans/round/dispatch/p50_ms"]["max"] == \
+        pytest.approx(48.0)
+    assert sec["Memory/HBM_Peak_Bytes"]["max"] == \
+        pytest.approx(4 * 123456.0)
+    # device metrics from the re-parsed capture are pinnable too
+    assert "Device/Collective_Frac" in sec
+    assert report.main([run, "--baseline", bl]) == 0
+    # other backends' sections survive a refresh
+    pinned["budgets"]["cpu"] = {"Spans/x/p50_ms": {"max": 7.0}}
+    json.dump(pinned, open(bl, "w"))
+    report.main([run, "--baseline", bl, "--write-baseline"])
+    assert json.load(open(bl))["budgets"]["cpu"] == {
+        "Spans/x/p50_ms": {"max": 7.0}}
+
+
+def test_report_missing_run_dir_is_usage_error(tmp_path):
+    assert report.main([str(tmp_path / "nope")]) == 2
+
+
+def test_repo_baseline_parses_and_carries_cpu_pins():
+    """The committed obs_baseline.json is loadable and pins the CPU
+    driver-smoke metrics CI judges."""
+    bl = report.load_baseline(os.path.join(ROOT, "obs_baseline.json"))
+    assert "cpu" in bl["budgets"]
+    assert "Spans/round/dispatch/p50_ms" in bl["budgets"]["cpu"]
+    assert bl.get("tolerance", 0) >= 1.0
