@@ -55,10 +55,11 @@ HOT_PATH_MODULES = (
 # Every entry must say WHY the rule does not apply — these are the
 # documented escape hatches, not a dumping ground.
 ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
-    (f"{PKG}/train.py", "run._emit_eval_body"): {
-        "host-sync": "runs on the MetricsDrain thread (async mode) or at "
-                     "the eval boundary after an explicit device_get (sync "
-                     "mode); values are already host-side",
+    (f"{PKG}/train.py", "_emit_eval_body"): {
+        "host-sync": "RoundEngine._emit_eval_body runs on the MetricsDrain "
+                     "thread (async mode) or at the eval boundary after an "
+                     "explicit device_get (sync mode); values are already "
+                     "host-side",
     },
     (f"{PKG}/obs/telemetry.py", "emit_scalars"): {
         "host-sync": "host emit path shared by the sync/async metrics "
@@ -259,6 +260,31 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         collective_budget={**zero, "psum": n_leaves + 1,
                            "all_gather": 3},
         hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+
+    # client churn (ISSUE 6, service/churn.py): the lifecycle mask is a
+    # replicated draw feeding the participation-mask protocol — the
+    # acceptance claim is ZERO collectives beyond the plain family's plan
+    # (vmap stays collective-free; the sharded budget is unchanged), and
+    # churn + faults together still cost only the ONE [m]-bit validation
+    # all_gather the faults path already pays.
+    churn = {"churn_available": 0.75, "churn_period": 4}
+    specs["vmap_rlr_avg_churn"] = CheckSpec(
+        name="vmap_rlr_avg_churn", family="round", sharded=False,
+        cfg_overrides=dict(churn), collective_budget=dict(zero))
+    specs["sharded_rlr_avg_churn"] = CheckSpec(
+        name="sharded_rlr_avg_churn", family="round_sharded", sharded=True,
+        cfg_overrides=dict(churn),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_churn_faults"] = CheckSpec(
+        name="sharded_rlr_avg_churn_faults", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**churn, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
     return specs
 
 

@@ -76,9 +76,10 @@ class Config:
                                     # shard stacks gathered + device_put
                                     # ahead of the compute (0 = synchronous;
                                     # a unit is one round, or `chain` rounds
-                                    # when chained — up to N+1 units
+                                    # when chained — up to N+2 units
                                     # resident: N queued + 1 in the
-                                    # worker's hand)
+                                    # worker's hand + 1 retained for
+                                    # supervised retry)
     host_sampled: str = "auto"      # auto: shard stacks above the device-
                                     # resident budget (2 GiB) gather on host
                                     # per round; on/off forces the mode
@@ -109,6 +110,39 @@ class Config:
                                     # scaled: threshold * n_eff / m keeps
                                     # the required agreement fraction
                                     # invariant under churn
+    # --- client churn: arrive/depart/rejoin lifecycles (service/churn.py) ---
+    churn_available: float = 1.0    # fraction of lifecycle phases a client
+                                    # is present; 1.0 = always there (the
+                                    # dense path, bit-identical); <1 routes
+                                    # the round through the participation
+                                    # mask with away clients excluded
+    churn_period: int = 32          # rounds per lifecycle phase: a client's
+                                    # stays/absences last whole phases, so
+                                    # departures persist (unlike per-round
+                                    # dropout) and rejoins happen on phase
+                                    # boundaries
+    churn_seed: int = 0             # seeds the lifecycle streams —
+                                    # independent of --seed so the cohort
+                                    # process can be re-drawn without
+                                    # touching any training key stream
+    # --- continuous-service driver (service/driver.py) ---
+    service_rounds: int = 0         # serve(): total rounds to stream; 0 =
+                                    # indefinitely (until the stop file
+                                    # <log_dir>/service.stop appears)
+    service_retries: int = 3        # supervised retries per failed unit
+    service_backoff_s: float = 0.25  # exponential-backoff base (doubles
+                                    # per attempt)
+    service_deadline_s: float = 0.0  # per-unit soft deadline; a unit past
+                                    # it classifies as wedged (0 = off)
+    service_keep_ckpts: int = -1    # checkpoints retained on disk (keep-K
+                                    # pruning). -1 = auto: keep everything
+                                    # in the one-shot trainer, 3 under
+                                    # serve() (which checkpoints forever
+                                    # and must bound the directory);
+                                    # 0 = keep everything explicitly
+    chaos: str = ""                 # deterministic fault-injection spec
+                                    # (service/chaos.py), e.g.
+                                    # "kill@7,corrupt_ckpt@4,wedge@3"
     # --- compile persistence & async dispatch (utils/compile_cache.py) ---
     compile_cache: bool = True      # persistent XLA cache + serialized-
                                     # executable AOT bank (warm starts skip
@@ -176,6 +210,13 @@ class Config:
         path bit-for-bit."""
         return (self.dropout_rate > 0 or self.straggler_rate > 0
                 or self.corrupt_rate > 0 or self.payload_norm_cap > 0)
+
+    @property
+    def churn_enabled(self) -> bool:
+        """Client churn is on when availability is a real fraction. The
+        lifecycle mask then joins the participation-mask protocol
+        (faults/masking.py); 1.0 keeps the dense path bit-for-bit."""
+        return self.churn_available < 1.0
 
     @property
     def effective_server_lr(self) -> float:
@@ -274,6 +315,19 @@ FIELD_PROVENANCE = {
     "payload_norm_cap": "program",
     "faults_spare_corrupt": "program",
     "rlr_threshold_mode": "program",
+    "churn_available": "program",  # churn path is traced (service/churn.py
+                                   # draws ride the round program)
+    "churn_period": "program",
+    "churn_seed": "program",       # baked into the traced lifecycle key
+                                   # (PRNGKey(churn_seed) is a program
+                                   # constant, unlike --seed whose keys are
+                                   # program ARGUMENTS)
+    "service_rounds": "runtime",   # service/driver.py streaming budget
+    "service_retries": "runtime",  # supervisor policy (service/supervisor)
+    "service_backoff_s": "runtime",
+    "service_deadline_s": "runtime",
+    "service_keep_ckpts": "runtime",
+    "chaos": "runtime",            # fault injection is host-side only
     "compile_cache": "runtime",
     "compile_cache_dir": "runtime",
     "async_metrics": "runtime",
@@ -379,7 +433,7 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    help="host-sampled mode: dispatch units (1 round, or "
                         "--chain rounds when chained) of shard stacks "
                         "gathered + device_put ahead of the compute "
-                        "(0=synchronous; device memory holds up to N+1 "
+                        "(0=synchronous; device memory holds up to N+2 "
                         "units in flight)")
     p.add_argument("--host_sampled", choices=("auto", "on", "off"),
                    default=d.host_sampled,
@@ -430,6 +484,40 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.rlr_threshold_mode,
                    help="RLR vote threshold under faults: abs = paper's "
                         "absolute count; scaled = threshold * n_eff / m")
+    p.add_argument("--churn_available", type=float, default=d.churn_available,
+                   help="client-churn availability: fraction of lifecycle "
+                        "phases a client is present (service/churn.py); "
+                        "1.0 = no churn (bit-identical dense path)")
+    p.add_argument("--churn_period", type=int, default=d.churn_period,
+                   help="rounds per churn lifecycle phase — stays/absences "
+                        "last whole phases, so departures persist and "
+                        "rejoins land on phase boundaries")
+    p.add_argument("--churn_seed", type=int, default=d.churn_seed,
+                   help="seeds the client lifecycle streams (independent "
+                        "of --seed)")
+    p.add_argument("--service_rounds", type=int, default=d.service_rounds,
+                   help="service mode: total rounds to stream (0 = run "
+                        "until <log_dir>/service.stop appears)")
+    p.add_argument("--service_retries", type=int, default=d.service_retries,
+                   help="service mode: supervised retries per failed "
+                        "dispatch/eval/checkpoint unit")
+    p.add_argument("--service_backoff_s", type=float,
+                   default=d.service_backoff_s,
+                   help="service mode: exponential-backoff base seconds "
+                        "(doubles per retry)")
+    p.add_argument("--service_deadline_s", type=float,
+                   default=d.service_deadline_s,
+                   help="service mode: per-unit soft deadline in seconds; "
+                        "a unit exceeding it is classified wedged (0=off)")
+    p.add_argument("--service_keep_ckpts", type=int,
+                   default=d.service_keep_ckpts,
+                   help="checkpoints retained on disk (keep-K pruning; "
+                        "-1 = auto: keep everything one-shot, 3 in "
+                        "service mode; 0 = keep everything)")
+    p.add_argument("--chaos", type=str, default=d.chaos,
+                   help="deterministic fault-injection spec for the "
+                        "service driver (service/chaos.py), e.g. "
+                        "'kill@7,corrupt_ckpt@4,wedge@3,slow_eval@2'")
     p.add_argument("--no_compile_cache", action="store_true",
                    help="disable the persistent XLA compilation cache and "
                         "the serialized-executable AOT bank "

@@ -38,7 +38,8 @@ class RoundPrefetcher:
     Memory note: effective pipeline depth is `depth + 1` payloads resident
     at once — the queue holds `depth` plus one in the worker's hand mid-put.
     Callers sizing device memory against `--host_prefetch N` should budget
-    N+1 payloads; a payload is one dispatch UNIT — a single round's [m, ...]
+    N+2 payloads (N queued, one being dispatched, one retained for retry —
+    see get()); a payload is one dispatch UNIT — a single round's [m, ...]
     stacks, or a whole [chain, m, ...] block in chained host mode
     (documented in the flag help too)."""
 
@@ -54,6 +55,7 @@ class RoundPrefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err = None
+        self._last = None  # (rnd, payload) most recently served — see get()
         self._thread = threading.Thread(
             target=self._worker, args=(produce, rounds), daemon=True)
         self._thread.start()
@@ -89,6 +91,14 @@ class RoundPrefetcher:
         constructor's round order). Never hangs silently: while waiting it
         logs a stall heartbeat every STALL_WARN_SEC so a wedged produce()
         (hung host gather / device_put) is attributable."""
+        if self._last is not None and self._last[0] == rnd:
+            # repeat request for the round just served: a supervised retry
+            # (service/supervisor.py) re-dispatches the SAME unit after a
+            # transient failure — popping the queue again would hand it the
+            # NEXT round and trip the order check below. Costs one retained
+            # payload (the +1 in the N+2 budget above), replaced on the
+            # next distinct get.
+            return self._last[1]
         waited = 0.0
         while True:
             try:
@@ -117,6 +127,7 @@ class RoundPrefetcher:
             raise RuntimeError(
                 f"prefetch order violation: driver asked for round {rnd}, "
                 f"pipeline produced round {got}")
+        self._last = (got, payload)
         return payload
 
     def close(self) -> None:

@@ -33,19 +33,24 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate imp
 # fault observability scalars (faults/model.fault_scalars) that chained
 # blocks carry through their lax.scan alongside train_loss
 FAULT_INFO_KEYS = ("fault_dropped", "fault_straggled", "fault_voters")
+# everything a chained scan carries per-round besides train_loss/tel_*:
+# the fault counters plus the churn away count (service/churn.py)
+CHAINED_INFO_KEYS = FAULT_INFO_KEYS + ("churn_away",)
 
 
 def _pallas_applicable(cfg) -> bool:
     """The fused Pallas server step covers the (weighted-FedAvg or signSGD
     [+ RLR], no server noise) paths — the paper's headline configurations.
     Diagnostics need the explicit lr tree, which the fused kernel never
-    materializes; the faults path needs the participation mask threaded
-    through the vote, which the fused kernel does not take; defense
-    telemetry (obs/telemetry.py) likewise needs the explicit lr/aggregate
-    trees, so any --telemetry level falls back to the jnp path."""
+    materializes; the faults path — and the churn path, which rides the
+    same participation mask — needs the mask threaded through the vote,
+    which the fused kernel does not take; defense telemetry
+    (obs/telemetry.py) likewise needs the explicit lr/aggregate trees, so
+    any --telemetry level falls back to the jnp path."""
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
             and cfg.noise == 0 and not cfg.diagnostics
-            and not cfg.faults_enabled and cfg.telemetry == "off")
+            and not cfg.faults_enabled and not cfg.churn_enabled
+            and cfg.telemetry == "off")
 
 
 def host_takes_flags(cfg) -> bool:
@@ -105,7 +110,7 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
 
 
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
-                local_train, cfg, corrupt_flags=None):
+                local_train, cfg, corrupt_flags=None, churn_active=None):
     """Shared round body: vmapped local training + aggregation + update.
 
     With faults configured (cfg.faults_enabled) the round additionally
@@ -113,7 +118,14 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     truncates stragglers' epochs, injects corrupt payloads, validates
     payloads server-side, and aggregates over the resulting participation
     mask (faults/masking.py). `corrupt_flags` marks which sampled slots
-    hold malicious agents (for --faults_spare_corrupt)."""
+    hold malicious agents (for --faults_spare_corrupt).
+
+    `churn_active` ([m] bool, service/churn.py: the sampled clients'
+    lifecycle availability this round) ANDs into the same participation
+    mask — an away client's update never reaches aggregation, exactly
+    like a dropped one, with zero extra collectives. A churn-only round
+    (no fault rates) routes through the masking path too; an all-away
+    cohort degrades to a parameter-preserving no-op via guard_empty."""
     m = imgs.shape[0]
     agent_keys = jax.random.split(k_train, m)
     draw = None
@@ -140,6 +152,17 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         mask = draw.participate & fmodel.payload_valid(
             updates, cfg.payload_norm_cap)
         extras = fmodel.fault_scalars(draw, mask)
+    if churn_active is not None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+            churn as churn_mod)
+        mask = churn_active if mask is None else mask & churn_active
+        if draw is not None:
+            extras["fault_voters"] = masking.count_f32(mask)
+            extras["churn_away"] = churn_mod.churn_away(churn_active)
+        else:
+            extras = churn_mod.churn_only_scalars(churn_active, mask)
     if _pallas_applicable(cfg):   # never taken when faults are configured
         from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
             fused_rlr_avg_apply)
@@ -189,14 +212,18 @@ def make_chained(step, data, family: str = "chained"):
     as arguments at call time: a jit-closed-over array is inlined into the
     lowered program as a dense constant — for fedemnist-scale stacks that
     is a ~0.5 GiB HLO no compile service should (or will) swallow."""
+    # churn steps take the round index (the scan already carries it)
+    takes_round = getattr(step, "takes_round", False)
+
     @functools.partial(jax.jit, donate_argnums=0)
     def chained(params, base_key, round_ids, *data_args):
         def body(params, rnd):
+            lead = (rnd,) if takes_round else ()
             new_params, info = step(params, jax.random.fold_in(base_key, rnd),
-                                    *data_args)
+                                    *lead, *data_args)
             out = {"train_loss": info["train_loss"],
                    "sampled": info["sampled"]}
-            out.update({k: info[k] for k in FAULT_INFO_KEYS if k in info})
+            out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
             # telemetry scalars (obs/telemetry.py) ride the scan stacked
             # per-round, like the fault counters
             out.update({k: v for k, v in info.items()
@@ -233,7 +260,7 @@ def _make_sample_step(cfg, model, normalize):
     local_train = make_local_train(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
 
-    def step(params, key, images, labels, sizes):
+    def body(params, key, rnd, images, labels, sizes):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         with jax.named_scope("sample_gather"):
             sampled = jax.random.permutation(k_sample, K)[:m]
@@ -244,23 +271,44 @@ def _make_sample_step(cfg, model, normalize):
         # telemetry needs them for the honest/corrupt cosine split
         # (host_takes_flags is the single source of that condition)
         want_flags = host_takes_flags(cfg)
+        churn_active = None
+        if cfg.churn_enabled:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+                churn as churn_mod)
+            with jax.named_scope("churn_mask"):
+                churn_active = churn_mod.active_slots(cfg, sampled, rnd)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
             local_train=local_train, cfg=cfg,
             corrupt_flags=(sampled < cfg.num_corrupt
-                           if want_flags else None))
+                           if want_flags else None),
+            churn_active=churn_active)
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
+    if cfg.churn_enabled:
+        # churn needs the round index in-program (the lifecycle phase is a
+        # function of time, not of the round key): the step grows a traced
+        # int32 `rnd` argument, threaded by the driver / the chained scan
+        def step(params, key, rnd, images, labels, sizes):
+            return body(params, key, rnd, images, labels, sizes)
+        step.takes_round = True
+        return step
+
+    def step(params, key, images, labels, sizes):
+        return body(params, key, jnp.int32(0), images, labels, sizes)
+    step.takes_round = False
     return step
 
 
 def bind_data(step_jit, data, family: str = "round"):
-    """(params, key, *data) jitted fn -> (params, key) fn with the dataset
-    stacks bound at call time (passed as jit arguments every call; one
-    compilation serves every round since shapes never change)."""
-    def bound(params, key):
-        return step_jit(params, key, *data)
+    """(params, key[, rnd], *data) jitted fn -> (params, key[, rnd]) fn
+    with the dataset stacks bound at call time (passed as jit arguments
+    every call; one compilation serves every round since shapes never
+    change). The optional `rnd` lead argument is the churn path's round
+    index (service/churn.py)."""
+    def bound(params, key, *lead):
+        return step_jit(params, key, *lead, *data)
 
     bound.jitted, bound.data = step_jit, data   # for lowering-size tests
     bound.family = family   # AOT manifest name (utils/compile_cache.py)
@@ -307,6 +355,14 @@ def make_host_step(cfg, model, normalize, take_flags=None):
     scan has no per-round flag channel, so it degrades the telemetry
     cosine split to all-honest instead of changing its calling
     convention."""
+    if cfg.churn_enabled:
+        # the host-sampled program never sees the sampled client ids, so
+        # the in-program lifecycle draw has nothing to hash; host-side
+        # churn-aware cohorting is future work (ROADMAP). Fail loudly
+        # rather than silently running a churn-free round.
+        raise ValueError(
+            "client churn (--churn_available < 1) is not supported in "
+            "host-sampled mode; run device-resident (--host_sampled off)")
     local_train = make_local_train(model, cfg, normalize)
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
@@ -358,7 +414,7 @@ def make_chained_host(step):
             new_params, info = step(
                 params, jax.random.fold_in(base_key, rnd), im, lb, sz)
             out = {"train_loss": info["train_loss"]}
-            out.update({k: info[k] for k in FAULT_INFO_KEYS if k in info})
+            out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
             out.update({k: v for k, v in info.items()
                         if k.startswith("tel_")})
             return new_params, out

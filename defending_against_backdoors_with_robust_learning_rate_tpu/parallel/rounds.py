@@ -321,11 +321,15 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         _pallas_applicable, host_takes_flags)
     faults_on = cfg.faults_enabled
+    churn_on = cfg.churn_enabled
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     if faults_on:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
             model as fmodel)
+    if churn_on:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+            churn as churn_mod)
     local_train = make_local_train(model, cfg, normalize)
     m = cfg.agents_per_round
     d = mesh.devices.size
@@ -333,16 +337,28 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
     mb = m // d
 
     def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
-        corrupt_full = rest[0] if take_flags else None
+        # trailing replicated [m] inputs, in order: corrupt flags (faults /
+        # full telemetry), then the churn availability mask — the caller
+        # computes the lifecycle draw OUTSIDE shard_map (it needs the
+        # sampled ids + round index) and it arrives replicated, so churn
+        # adds ZERO collectives (analysis *_churn specs pin this)
+        idx = 0
+        corrupt_full = churn_full = None
+        if take_flags:
+            corrupt_full = rest[idx]
+            idx += 1
+        if churn_on:
+            churn_full = rest[idx]
         mask_local = mask_full = draw = ep_local = None
-        if faults_on:
-            # replicated draw: every device computes the same [m] pattern
-            draw = fmodel.sample_faults(cfg, fmodel.fault_key(noise_key), m,
-                                        corrupt_full)
+        if faults_on or churn_on:
             pos = jax.lax.axis_index(AGENTS_AXIS) * mb
 
             def local(v):
                 return jax.lax.dynamic_slice_in_dim(v, pos, mb, 0)
+        if faults_on:
+            # replicated draw: every device computes the same [m] pattern
+            draw = fmodel.sample_faults(cfg, fmodel.fault_key(noise_key), m,
+                                        corrupt_full)
             if cfg.straggler_rate > 0:
                 ep_local = local(draw.ep_budget)
         # chunking applies to the per-device agent block (m/d agents)
@@ -360,6 +376,13 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
                 fmodel.payload_valid(updates, cfg.payload_norm_cap),
                 AGENTS_AXIS, axis=0, tiled=True)
             mask_full = draw.participate & valid
+            mask_local = local(mask_full)
+        if churn_full is not None:
+            # the replicated lifecycle mask joins the participation mask
+            # exactly like a dropout draw — away clients are excluded
+            # arithmetically, no shape changes, no collective
+            mask_full = (churn_full if mask_full is None
+                         else mask_full & churn_full)
             mask_local = local(mask_full)
         if _pallas_applicable(cfg):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
@@ -386,6 +409,11 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
         extras = {}
         if faults_on:
             extras.update(fmodel.fault_scalars(draw, mask_full))
+            if churn_full is not None:
+                extras["churn_away"] = churn_mod.churn_away(churn_full)
+        elif churn_full is not None:
+            extras.update(churn_mod.churn_only_scalars(churn_full,
+                                                       mask_full))
         if cfg.telemetry != "off":
             from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
                 telemetry)
@@ -408,10 +436,12 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
         return new_params, loss, extras
 
     extras_specs = {}
-    if faults_on:
+    if faults_on or churn_on:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
             FAULT_INFO_KEYS)
         extras_specs.update({k: P() for k in FAULT_INFO_KEYS})
+    if churn_on:
+        extras_specs["churn_away"] = P()
     if cfg.telemetry != "off":
         from defending_against_backdoors_with_robust_learning_rate_tpu.obs.telemetry import (
             telemetry_keys)
@@ -422,7 +452,8 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
             extras_specs["lr_flat"] = P()
 
     in_specs = (P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
-                P(AGENTS_AXIS), P()) + ((P(),) if take_flags else ())
+                P(AGENTS_AXIS), P()) + ((P(),) if take_flags else ()) \
+        + ((P(),) if churn_on else ())
     return shard_map(
         shard_body, mesh=mesh,
         in_specs=in_specs,
@@ -446,7 +477,7 @@ def _make_sample_step(cfg, model, normalize, mesh):
     K, m = cfg.num_agents, cfg.agents_per_round
     want_flags = host_takes_flags(cfg)
 
-    def step(params, key, images, labels, sizes):
+    def body(params, key, rnd, images, labels, sizes):
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         with jax.named_scope("sample_gather"):
             sampled = jax.random.permutation(k_sample, K)[:m]
@@ -455,11 +486,27 @@ def _make_sample_step(cfg, model, normalize, mesh):
             szs = jnp.take(sizes, sampled, axis=0)
         agent_keys = jax.random.split(k_train, m)
         extra = ((sampled < cfg.num_corrupt,) if want_flags else ())
+        if cfg.churn_enabled:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+                churn as churn_mod)
+            # lifecycle draw computed OUTSIDE shard_map (it needs the
+            # sampled ids + round index); enters the body replicated
+            with jax.named_scope("churn_mask"):
+                extra = extra + (churn_mod.active_slots(cfg, sampled, rnd),)
         new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
                                                  agent_keys, k_noise, *extra)
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
+    if cfg.churn_enabled:
+        def step(params, key, rnd, images, labels, sizes):
+            return body(params, key, rnd, images, labels, sizes)
+        step.takes_round = True
+        return step
+
+    def step(params, key, images, labels, sizes):
+        return body(params, key, jnp.int32(0), images, labels, sizes)
+    step.takes_round = False
     return step
 
 
@@ -485,6 +532,12 @@ def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):
     host paths are comparable round-for-round."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         host_takes_flags)
+    if cfg.churn_enabled:
+        # same contract as fl/rounds.make_host_step: the host-sampled
+        # program never sees the sampled ids the lifecycle draw hashes
+        raise ValueError(
+            "client churn (--churn_available < 1) is not supported in "
+            "host-sampled mode; run device-resident (--host_sampled off)")
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     sharded = _build_sharded_body(cfg, model, normalize, mesh,

@@ -1,0 +1,22 @@
+"""Continuous-service subsystem: the one-shot trainer as a long-running,
+supervised FL service.
+
+    churn.py       seeded arrive/depart/rejoin client lifecycles — the
+                   cohort process as a simulator primitive (FedJAX,
+                   arXiv:2108.02117), feeding the existing
+                   participation-mask protocol with zero extra collectives
+    supervisor.py  deadline + exponential-backoff retry around every
+                   dispatch/eval/checkpoint unit, with failure
+                   classification (transient / wedged / poisoned) and
+                   graceful degradation
+    chaos.py       deterministic fault injector (kill-mid-round,
+                   wedge-dispatch, wedge-drain, corrupt-checkpoint,
+                   slow-eval) the recovery tests and the CI chaos drill
+                   drive
+    driver.py      the service loop: rounds stream under churn, units run
+                   supervised, checkpoints are journaled for crash-exact
+                   resume (utils/checkpoint.py)
+    queue.py       experiment queue: scenario cells back-to-back in one
+                   process against one AOT bank (FL_PyTorch's
+                   simulator-as-service gap, arXiv:2202.03099)
+"""

@@ -1,0 +1,204 @@
+"""Deterministic chaos injection for the service driver's recovery drills.
+
+The crash-exact recovery claim (ISSUE 6) is only worth anything if it is
+*driven*: this module injects, at test-chosen rounds, exactly the failures
+the r4/r5 sessions met in the wild — a process killed mid-round, a wedged
+dispatch, a stalled metrics drain, a checkpoint truncated on disk, an eval
+that crawls. The driver calls one hook per unit; the spec decides what
+fires.
+
+Spec grammar (``--chaos``): comma-separated ``action@round`` terms, each
+optionally ``xN`` (fire on the first N attempts — wedges that survive one
+retry) and/or ``:arg`` (seconds for the slow/wedge actions)::
+
+    kill@7                 SIGKILL self right after round 7's dispatch
+                           (mid-round w.r.t. the eval/checkpoint boundary)
+    wedge@3                dispatch attempt 1 of round 3 raises a
+    wedge@3x2              transient UNAVAILABLE ChaosError (x2: first two
+                           attempts — exercises repeated backoff)
+    poison@5               round 5's dispatch raises a deterministic
+                           (non-retryable) error on every attempt
+    poison_eval@4          round 4's eval raises deterministically
+                           (drives the skip-eval degradation)
+    slow_eval@2:0.4        round 2's eval sleeps 0.4s (deadline/slow-unit
+                           classification)
+    wedge_drain@6:0.8      a 0.8s blocker is queued on the metrics drain
+                           at round 6 (the checkpoint flush then times
+                           out -> wedged -> sync-metrics degradation)
+    corrupt_ckpt@4         round 4's just-saved checkpoint gets its bytes
+                           flipped on disk (digest-verified restore must
+                           fall back to the previous one)
+
+Injections persist their fire counts in a small state file (atomic
+rewrite) so a ``kill`` does NOT re-fire after the resumed process replays
+its round — the whole point is to crash once and then observe a clean
+recovery. ``kill`` marks its state BEFORE raising SIGKILL for the same
+reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import signal
+import time
+from typing import Dict, List, Optional
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.checkpoint import (
+    atomic_write_text)
+
+ACTIONS = ("kill", "wedge", "poison", "poison_eval", "slow_eval",
+           "wedge_drain", "corrupt_ckpt")
+
+_TERM_RE = re.compile(
+    r"^(?P<action>[a-z_]+)@(?P<round>\d+)"
+    r"(?:x(?P<count>\d+))?(?::(?P<arg>[0-9.]+))?$")
+
+
+class ChaosError(RuntimeError):
+    """Injected failure. The message carries the transient/poisoned
+    signature the supervisor classifies on."""
+
+
+@dataclasses.dataclass
+class Injection:
+    action: str
+    rnd: int
+    count: int = 1        # how many times it fires (attempts, for wedges)
+    arg: float = 0.0      # seconds for slow/wedge actions
+
+    @property
+    def key(self) -> str:
+        return f"{self.action}@{self.rnd}"
+
+
+def parse_spec(spec: str) -> List[Injection]:
+    out: List[Injection] = []
+    for term in filter(None, (t.strip() for t in (spec or "").split(","))):
+        m = _TERM_RE.match(term)
+        if not m or m.group("action") not in ACTIONS:
+            raise ValueError(
+                f"bad chaos term {term!r}; expected action@round[xN][:arg] "
+                f"with action in {ACTIONS}")
+        out.append(Injection(m.group("action"), int(m.group("round")),
+                             int(m.group("count") or 1),
+                             float(m.group("arg") or 0.0)))
+    return out
+
+
+class Chaos:
+    """The injector: holds the parsed spec + persisted fire counts."""
+
+    def __init__(self, spec: str, state_path: Optional[str] = None):
+        self.injections = parse_spec(spec)
+        self.state_path = state_path
+        self._fired: Dict[str, int] = {}
+        if state_path and os.path.exists(state_path):
+            try:
+                with open(state_path, encoding="utf-8") as f:
+                    self._fired = {k: int(v)
+                                   for k, v in json.load(f).items()}
+            except (OSError, ValueError):
+                self._fired = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.injections)
+
+    def _mark(self, inj: Injection) -> None:
+        self._fired[inj.key] = self._fired.get(inj.key, 0) + 1
+        if self.state_path:
+            atomic_write_text(self.state_path, json.dumps(self._fired))
+
+    def _due(self, action: str, rnd: int) -> Optional[Injection]:
+        for inj in self.injections:
+            if (inj.action == action and inj.rnd == rnd
+                    and self._fired.get(inj.key, 0) < inj.count):
+                return inj
+        return None
+
+    # ------------------------------------------------------------- hooks
+
+    def on_dispatch(self, rnd: int) -> None:
+        """Called before round ``rnd``'s dispatch (every attempt)."""
+        inj = self._due("wedge", rnd)
+        if inj is not None:
+            self._mark(inj)
+            if inj.arg > 0:
+                time.sleep(inj.arg)
+            raise ChaosError(
+                f"UNAVAILABLE: injected wedged dispatch at round {rnd} "
+                f"(chaos {inj.key})")
+        inj = self._due("poison", rnd)
+        if inj is not None:
+            # NOT marked exhausted per attempt beyond count: a poisoned
+            # unit is deterministic — every retry reproduces it
+            raise ChaosError(
+                f"injected deterministic failure at round {rnd} "
+                f"(chaos {inj.key})")
+
+    def maybe_kill(self, rnd: int) -> None:
+        """Called after round ``rnd``'s dispatch: kill -9 mid-round. Marks
+        state FIRST (the next life must not re-fire while replaying)."""
+        inj = self._due("kill", rnd)
+        if inj is None:
+            return
+        self._mark(inj)
+        print(f"[chaos] kill -9 after round {rnd}'s dispatch "
+              f"({inj.key})", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_eval(self, rnd: int) -> None:
+        inj = self._due("slow_eval", rnd)
+        if inj is not None:
+            self._mark(inj)
+            time.sleep(inj.arg or 0.5)
+        inj = self._due("poison_eval", rnd)
+        if inj is not None:
+            raise ChaosError(
+                f"injected deterministic eval failure at round {rnd} "
+                f"(chaos {inj.key})")
+
+    def drain_blocker_secs(self, rnd: int) -> Optional[float]:
+        """Seconds a drain blocker should sleep at round ``rnd`` (the
+        driver submits the sleeper — this module never touches the drain
+        directly), or None."""
+        inj = self._due("wedge_drain", rnd)
+        if inj is None:
+            return None
+        self._mark(inj)
+        return inj.arg or 0.5
+
+    def corrupt_checkpoint(self, ckpt_dir: str, rnd: int) -> bool:
+        """After the round-``rnd`` checkpoint save: flip bytes in the
+        newest checkpoint's largest file, leaving the digest sidecar in
+        place — the restore path must *detect* the corruption (digest
+        mismatch) and fall back. Returns True when it fired."""
+        inj = self._due("corrupt_ckpt", rnd)
+        if inj is None:
+            return False
+        self._mark(inj)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+            checkpoint as ckpt)
+        rounds = ckpt.saved_rounds(ckpt_dir)
+        if not rounds:
+            return False
+        path = os.path.join(os.path.abspath(ckpt_dir),
+                            f"round_{rounds[-1]:06d}")
+        victim, vsize = None, -1
+        for base, _dirs, files in os.walk(path):
+            for name in files:
+                fp = os.path.join(base, name)
+                size = os.path.getsize(fp)
+                if size > vsize:
+                    victim, vsize = fp, size
+        if victim is None:
+            return False
+        with open(victim, "r+b") as f:
+            f.seek(max(0, vsize // 2))
+            f.write(b"\xde\xad\xbe\xef")
+        print(f"[chaos] corrupted checkpoint file {victim} "
+              f"({inj.key})", flush=True)
+        return True

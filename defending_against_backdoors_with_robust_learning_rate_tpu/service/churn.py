@@ -1,0 +1,99 @@
+"""Seeded client-churn lifecycles: arrive / depart / rejoin as a pure
+function of (client id, round).
+
+`faults/model.py` models *within-round* failures: a per-round Bernoulli
+dropout draw has no memory, so a "failed" client is back next round. A
+production FL population churns differently — a departed client stays away
+for a while and may rejoin later (FedJAX, arXiv:2108.02117, makes this
+cohort process a first-class simulator primitive). This module generalizes
+the fault machinery to that regime while keeping every property the faults
+design bought:
+
+- **pure function of (client, round)**: time is cut into per-client
+  lifecycle phases of ``churn_period`` rounds (each client gets a seeded
+  phase offset, so phase boundaries don't align across the population);
+  the client is present for a whole phase iff a per-(client, phase)
+  uniform draw clears ``churn_available``. Presence at any round is
+  computable in O(1) with NO sequential state — which is exactly what
+  makes crash recovery exact: a resumed run reconstructs the identical
+  lifecycle history from the config alone.
+- **replicated, collective-free**: the draw depends only on program
+  constants (``churn_seed``) and traced per-slot values, so every device
+  of a mesh computes the identical mask — like the fault draw, no
+  collective is needed to agree on who is away (pinned by the
+  ``*_churn`` specs in analysis/contracts.py).
+- **participation-mask protocol**: the [m] availability bools AND into
+  the same mask the aggregation rules already honor
+  (faults/masking.py) — away clients are excluded arithmetically, shapes
+  stay static, one compiled program serves every churn pattern.
+
+The lifecycle key derives from ``cfg.churn_seed`` (its own `program`
+config field), NOT from ``cfg.seed``: training keys are program
+*arguments* (runtime provenance), while the churn stream is baked into
+the traced program as a constant — and the cohort process can be re-drawn
+without perturbing any training stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fold_in tag separating the churn lifecycle stream from every PRNGKey(seed)
+# stream any other subsystem derives
+CHURN_KEY_TAG = 0xC4A21
+
+
+def churn_key(cfg):
+    """Base key of the lifecycle streams (a traced constant)."""
+    return jax.random.fold_in(jax.random.PRNGKey(cfg.churn_seed),
+                              CHURN_KEY_TAG)
+
+
+def active_slots(cfg, client_ids, rnd):
+    """[m] bool — is each client present at round ``rnd``?
+
+    ``client_ids`` is any int array of client ids (the round's sampled
+    slots, or ``arange(K)`` for a population census); ``rnd`` may be a
+    traced int32 scalar (the round program under churn takes the round
+    index as an argument) or a Python int (host-side mirror — same jax
+    ops, bit-identical answer)."""
+    period = max(1, int(cfg.churn_period))
+    p = jnp.float32(cfg.churn_available)
+    base = churn_key(cfg)
+
+    def one(cid):
+        k_off, k_phase = jax.random.split(jax.random.fold_in(base, cid))
+        # per-client phase offset de-aligns phase boundaries across the
+        # population, so arrivals/departures are spread over rounds
+        # instead of synchronizing at multiples of the period
+        off = jax.random.randint(k_off, (), 0, period)
+        phase = (rnd + off) // period
+        return jax.random.uniform(jax.random.fold_in(k_phase, phase)) < p
+
+    return jax.vmap(one)(jnp.asarray(client_ids, jnp.int32))
+
+
+def active_count(cfg, rnd) -> int:
+    """Host-side census: how many of the K clients are present at round
+    ``rnd``. Service-driver observability only (snap cadence) — never on
+    the hot path."""
+    return int(np.asarray(
+        jnp.sum(active_slots(cfg, jnp.arange(cfg.num_agents), int(rnd)))))
+
+
+def churn_away(churn_active):
+    """Scalar: sampled slots whose client is away this round (the
+    Churn/Sampled_Away series)."""
+    return jnp.sum((~churn_active).astype(jnp.float32))
+
+
+def churn_only_scalars(churn_active, mask):
+    """Faults/*-compatible scalar set for a churn-without-faults round
+    (there is no fault draw to count): nothing dropped or straggled, the
+    effective electorate is the churn mask."""
+    return {"fault_dropped": jnp.float32(0.0),
+            "fault_straggled": jnp.float32(0.0),
+            "fault_voters": jnp.sum(mask.astype(jnp.float32)),
+            "churn_away": churn_away(churn_active)}

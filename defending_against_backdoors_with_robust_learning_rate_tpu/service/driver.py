@@ -1,0 +1,329 @@
+"""The continuous-service driver: rounds stream indefinitely, supervised.
+
+``train.run`` is one-shot — it assumes every dispatch lands, every eval
+returns, and the process lives to ``cfg.rounds``. ``serve`` turns the same
+RoundEngine into a long-running service (FL_PyTorch, arXiv:2202.03099,
+frames exactly this simulator-as-service gap):
+
+- **rounds stream** until ``--service_rounds`` is reached, or — with 0 —
+  until ``<log_dir>/service.stop`` appears; the client population churns
+  underneath via service/churn.py (device-resident paths; the engine
+  refuses churn + host-sampled).
+- **every unit is supervised** (service/supervisor.py): dispatch, eval and
+  checkpoint each run under deadline + exponential-backoff retry with
+  failure classification. Degradation policy on exhausted retries:
+  * eval failed        -> skip THIS boundary's eval (training continues;
+                          ``Service/Evals_Skipped`` counts the damage);
+  * checkpoint wedged  -> the async drain is stalled: close it (bounded)
+                          and fall back to synchronous metrics for the
+                          rest of the run, then checkpoint again;
+  * dispatch poisoned  -> nothing sane to drop — exit loudly with the
+                          journal intact (the next start resumes
+                          crash-exactly).
+- **crash-exact recovery**: before the metrics writer opens, the driver
+  finds the newest digest-valid checkpoint (utils/checkpoint.py),
+  truncates ``metrics.jsonl`` back to that round's journaled byte offset,
+  and resumes — replayed rounds rewrite the identical rows, so an
+  interrupted-and-resumed service produces a byte-identical metrics file
+  (modulo wall-clock rows) to one that never crashed. A ``kill -9`` at
+  ANY point (mid-round, mid-save, mid-journal) lands in one of the cases
+  utils/checkpoint.py enumerates; tests/test_service.py drives them
+  through service/chaos.py.
+
+Entry point::
+
+    python -m defending_against_backdoors_with_robust_learning_rate_tpu.service.driver \
+        --data synthetic --service_rounds 64 --snap 4 \
+        --churn_available 0.7 --checkpoint_dir ck --chaos kill@6
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+    Config, args_parser)
+from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+    chaos as chaos_mod, churn as churn_mod)
+from defending_against_backdoors_with_robust_learning_rate_tpu.service.supervisor import (
+    Supervisor, UnitFailure, WEDGED)
+from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+    RoundEngine)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+    checkpoint as ckpt)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+    MetricsWriter, NullWriter, run_name)
+
+STOP_FILE = "service.stop"
+
+
+def _metrics_path(cfg: Config) -> str:
+    return os.path.join(cfg.log_dir, run_name(cfg), "metrics.jsonl")
+
+
+def prepare_crash_exact_resume(cfg: Config, truncate: bool = True) -> Dict:
+    """Truncate the metrics stream to the journaled offset of the newest
+    digest-valid checkpoint, BEFORE any writer opens the file; a fresh
+    stream instead journals the file's current end as the round-0 splice
+    base. Returns what the recovery report needs.
+    ``boundary`` in the result says whether the writer should emit a
+    ``_run/start`` record: yes on a fresh stream or a pre-journal append
+    (readers must be able to split the runs), no on a crash-exact splice
+    (the recovered file must byte-match an uninterrupted run's).
+    ``truncate=False`` (non-lead processes) computes everything but leaves
+    the file alone — only the lead writer may cut the shared stream."""
+    info = {"resumed_from": 0, "metrics_offset": 0, "truncated_bytes": 0,
+            "resume_upto": None, "boundary": True}
+    if not cfg.checkpoint_dir:
+        return info
+    # the journal-AGREED round, not the newest digest-valid one: a kill
+    # between ckpt.save and journal_record leaves a newer unjournaled
+    # checkpoint whose metrics offset is unknown — resuming there would
+    # truncate the whole stream. resume_upto pins the engine's restore to
+    # the same round.
+    rnd = ckpt.newest_resumable_round(cfg.checkpoint_dir)
+    info["resumed_from"] = rnd or 0
+    info["resume_upto"] = rnd or 0
+    path = _metrics_path(cfg)
+    size = os.path.getsize(path) if os.path.exists(path) else 0
+    journal = ckpt.journal_read(cfg.checkpoint_dir)
+    if rnd is not None and not journal:
+        # pre-journal checkpoint dir: resumable, but no splice point
+        # exists — append rather than drop rows that cannot be replayed
+        print(f"[service] checkpoint dir has no round journal — resuming "
+              f"from round {rnd} without the crash-exact metrics splice")
+        info["metrics_offset"] = size
+        return info
+    if not journal:
+        # fresh service stream: journal the current end of the (append-
+        # across-runs) metrics file as the round-0 splice base, so a kill
+        # before the first checkpoint resumes by truncating back to HERE —
+        # never to 0, which would wipe rows earlier runs wrote
+        if truncate:
+            ckpt.journal_record(cfg.checkpoint_dir, 0, size)
+        info["metrics_offset"] = size
+        return info
+    offset = ckpt.journal_offset_for(cfg.checkpoint_dir, rnd or 0)
+    info["metrics_offset"] = offset
+    # a splice past a real checkpoint continues that run mid-stream with no
+    # extra record (byte-identity with an uninterrupted run); a round-0
+    # base resume restarts the run, which an uninterrupted serve would
+    # open with a boundary record
+    info["boundary"] = not rnd
+    if truncate and size > offset:
+        with open(path, "r+b") as f:
+            f.truncate(offset)
+        info["truncated_bytes"] = size - offset
+        print(f"[service] crash-exact resume: metrics.jsonl truncated "
+              f"to the round-{rnd or 0} journal offset "
+              f"({size - offset} bytes of un-checkpointed rows "
+              f"dropped for exact replay)")
+    return info
+
+
+def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
+          max_rounds: Optional[int] = None) -> Dict:
+    """Run the continuous service; returns the engine summary extended
+    with a ``service`` section (retry/degradation counters, recovery
+    info)."""
+    t_start = time.perf_counter()
+    total = max_rounds if max_rounds is not None else cfg.service_rounds
+    # supervision granularity is one round per dispatch unit; `rounds`
+    # is runtime-only (EXCLUDED_FIELDS), so neither replace recompiles
+    cfg = cfg.replace(chain=1, resume=bool(cfg.checkpoint_dir),
+                      rounds=(total or cfg.rounds),
+                      # -1 = auto: the service checkpoints forever and must
+                      # bound the directory (one-shot runs keep everything)
+                      service_keep_ckpts=(3 if cfg.service_keep_ckpts < 0
+                                          else cfg.service_keep_ckpts))
+    lead = jax.process_index() == 0
+    recovery = prepare_crash_exact_resume(cfg, truncate=lead)
+    if writer is None:
+        if lead:
+            writer = MetricsWriter(cfg.log_dir, run_name(cfg),
+                                   cfg.tensorboard,
+                                   boundary=recovery["boundary"])
+        else:
+            writer = NullWriter()
+
+    chaos = chaos_mod.Chaos(
+        cfg.chaos, state_path=(os.path.join(cfg.log_dir, "chaos_state.json")
+                               if cfg.chaos else None))
+    if chaos.active:
+        print(f"[service] chaos injections armed: {cfg.chaos}")
+
+    eng = RoundEngine(cfg, writer=writer,
+                      resume_upto=recovery["resume_upto"])
+    sup = Supervisor(retries=cfg.service_retries,
+                     backoff_s=cfg.service_backoff_s,
+                     deadline_s=cfg.service_deadline_s, hb=eng.hb)
+    if recovery["resumed_from"] and eng.start_round:
+        sup.phase("recover", recovered_round=eng.start_round)
+        print(f"[service] recovered at round {eng.start_round} "
+              f"in {time.perf_counter() - t_start:.2f}s")
+    stop_path = os.path.join(cfg.log_dir, STOP_FILE)
+    if cfg.churn_enabled:
+        print(f"[service] population census at start: "
+              f"{churn_mod.active_count(cfg, eng.start_round)}/"
+              f"{cfg.num_agents} clients active")
+
+    def unit_stream():
+        rnd = eng.start_round
+        while True:
+            if total and rnd >= total:
+                return
+            if not total and os.path.exists(stop_path):
+                print(f"[service] stop file {stop_path} — draining out")
+                return
+            rnd += 1
+            yield (rnd,)
+
+    # two independent iterations of the SAME stream: one for the loop, one
+    # pinned as the host-mode prefetcher's production order
+    eng.set_schedule(unit_stream())
+    evals_skipped = 0
+    try:
+        for unit in unit_stream():
+            rnd = unit[0]
+
+            def do_dispatch(unit=unit, rnd=rnd):
+                chaos.on_dispatch(rnd)
+                eng.dispatch(unit)
+
+            sup.run("dispatch", do_dispatch, unit=rnd)
+            # kill-mid-round drill: after dispatch, before the boundary's
+            # eval/checkpoint — the rows for this round must be replayed
+            # bit-identically by the resumed process
+            chaos.maybe_kill(rnd)
+
+            if rnd % cfg.snap == 0:
+                def do_eval(rnd=rnd):
+                    chaos.on_eval(rnd)
+                    eng.eval_boundary(rnd)
+
+                try:
+                    sup.run("eval", do_eval, unit=rnd)
+                except UnitFailure as e:
+                    if not (eng.drain is not None and eng.drain.dead):
+                        # degrade: skip THIS boundary's eval, keep
+                        # training — a broken eval set must not take down
+                        # the service
+                        evals_skipped += 1
+                        print(f"[service] degraded: eval at round {rnd} "
+                              f"skipped ({e.classification}); training "
+                              f"continues")
+                if eng.drain is not None and eng.drain.dead:
+                    # the drain thread died (its error surfaced through the
+                    # supervisor above, delivered-once): every later submit
+                    # would be a silent drop, so the skip-eval degradation
+                    # must not absorb this one. Fall back to synchronous
+                    # metrics and replay the boundary inline — if THAT
+                    # fails too, exit loudly with the journal intact.
+                    sup.phase("degraded", drain_dead_round=rnd)
+                    print("[service] degraded: metrics drain died — "
+                          "falling back to synchronous metrics and "
+                          f"replaying round {rnd}'s eval inline")
+                    eng.drain.close(raise_errors=False)
+                    eng.drain = None
+                    eng.eval_boundary(rnd)
+
+                secs = chaos.drain_blocker_secs(rnd)
+                if secs and eng.drain is not None:
+                    eng.drain.submit(lambda _v, s=secs: time.sleep(s), ())
+
+                def do_ckpt(rnd=rnd):
+                    if cfg.checkpoint_dir:
+                        eng.save_checkpoint(rnd,
+                                            drain_timeout=sup.stall_budget())
+                    else:
+                        # no checkpoint flush will run: barrier the drain
+                        # anyway, so the inline Service/* writes below never
+                        # race the drain thread on the shared writer
+                        eng.drain_flush(timeout=sup.stall_budget())
+
+                try:
+                    sup.run("checkpoint", do_ckpt, unit=rnd)
+                except UnitFailure as e:
+                    if e.classification == WEDGED and eng.drain is not None:
+                        # the drain is stalled: degrade to sync metrics.
+                        # close() gives the wedged callback a bounded
+                        # grace to finish (its rows land in order), then
+                        # the service continues inline.
+                        print("[service] degraded: metrics drain wedged — "
+                              "falling back to synchronous metrics")
+                        eng.drain.close(raise_errors=False,
+                                        timeout=2 * sup.stall_budget())
+                        eng.drain = None
+                        eng.save_checkpoint(rnd)
+                    else:
+                        raise
+                chaos.corrupt_checkpoint(cfg.checkpoint_dir, rnd)
+                if lead and cfg.churn_enabled:
+                    eng.writer.scalar(
+                        "Service/Active_Clients",
+                        churn_mod.active_count(cfg, rnd), rnd)
+                _emit_service_rows(eng, sup, evals_skipped, rnd)
+            eng.post_unit()
+        if eng.drain is not None:
+            eng.hb.update(phase="drain", force=True)
+            eng.drain.flush()
+    except UnitFailure:
+        # poisoned/give-up on a non-degradable unit: exit loudly, journal
+        # intact — the next `serve` resumes crash-exactly
+        eng.hb.update(phase="failed", force=True,
+                      **sup.heartbeat_fields())
+        raise
+    finally:
+        eng.close()
+    eng.hb.update(force=True, evals_skipped=evals_skipped,
+                  **sup.heartbeat_fields())
+    summary = eng.finalize()
+    summary["service"] = {
+        **sup.counters,
+        "evals_skipped": evals_skipped,
+        "phases_seen": list(sup.phases_seen),
+        "resumed_from": recovery["resumed_from"],
+        "truncated_bytes": recovery["truncated_bytes"],
+        "rounds_served": eng.rounds_done,
+        "wall_s": round(time.perf_counter() - t_start, 3),
+    }
+    print(f"[service] served {eng.rounds_done} round(s); "
+          f"retries={sup.counters['retries']} "
+          f"evals_skipped={evals_skipped} "
+          f"resumed_from={recovery['resumed_from']}")
+    return summary
+
+
+def _emit_service_rows(eng, sup: Supervisor, evals_skipped: int,
+                       rnd: int) -> None:
+    """Service/* counters at each boundary. Written inline (not through
+    the drain): they are service-life observability, excluded — like
+    Throughput/* — from the crash-exact row comparison."""
+    w = eng.writer
+    w.scalar("Service/Retries", sup.counters["retries"], rnd)
+    w.scalar("Service/Transient_Failures", sup.counters["transient"], rnd)
+    w.scalar("Service/Wedged_Failures", sup.counters["wedged"], rnd)
+    w.scalar("Service/Poisoned_Failures", sup.counters["poisoned"], rnd)
+    w.scalar("Service/Slow_Units", sup.counters["slow_units"], rnd)
+    w.scalar("Service/Evals_Skipped", evals_skipped, rnd)
+
+
+def main(argv=None) -> int:
+    cfg = args_parser(argv)
+    if cfg.platform:
+        jax.config.update("jax_platforms", cfg.platform)
+    if cfg.num_processes > 1 or cfg.coordinator:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+            multihost)
+        multihost.maybe_initialize(cfg.coordinator, cfg.num_processes,
+                                   cfg.process_id)
+    serve(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,150 @@
+"""Experiment queue: scenario cells back-to-back in ONE process.
+
+The ROADMAP's scenario matrix (attack x defense x faults x churn) needs a
+host that runs many cells without paying process startup + XLA per cell.
+This queue is that host: every cell is a set of Config overrides applied
+to one base config, executed sequentially in the SAME interpreter — so the
+persistent XLA cache and the AOT executable bank (utils/compile_cache.py)
+are shared across cells. Cells that differ only in runtime knobs (seed,
+rounds, faults rates at equal shapes) re-dispatch banked executables and
+never touch XLA; cells that change the program (aggr, telemetry, churn)
+compile once and bank for the NEXT queue run.
+
+Queue file (JSON): either a bare list of override dicts, or
+``{"cells": [{"name": ..., "overrides": {...}}, ...]}``::
+
+    [{"aggr": "avg", "churn_available": 0.8},
+     {"aggr": "sign", "server_lr": 1.0}]
+
+Each finished cell appends one flushed row to
+``<log_dir>/queue_results.jsonl`` (summary + the service counters when the
+cell ran in service mode), so a mid-queue kill keeps completed rows — the
+same crash discipline as the rest of the service subsystem. A cell whose
+run *fails* is recorded with its error and the queue moves on: one
+poisoned cell must not abort the matrix.
+
+Entry point::
+
+    python -m defending_against_backdoors_with_robust_learning_rate_tpu.service.queue \
+        --queue cells.json --data synthetic --rounds 8 --snap 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+    Config, args_parser)
+
+SUMMARY_KEYS = ("round", "val_acc", "val_loss", "poison_acc", "poison_loss",
+                "rounds_per_sec", "steady_rounds_per_sec", "params")
+
+
+def load_cells(path: str) -> List[Dict[str, Any]]:
+    """Parse the queue file into [{"name", "overrides"}] rows."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    raw = data.get("cells", data) if isinstance(data, dict) else data
+    if not isinstance(raw, list):
+        raise ValueError(f"queue file {path}: expected a list of cells")
+    cells = []
+    for i, cell in enumerate(raw):
+        if not isinstance(cell, dict):
+            raise ValueError(f"queue file {path}: cell {i} is not an object")
+        overrides = dict(cell.get("overrides", cell))
+        overrides.pop("name", None)
+        cells.append({"name": str(cell.get("name", f"cell{i:03d}")),
+                      "overrides": overrides})
+    return cells
+
+
+def _apply_overrides(base: Config, overrides: Dict[str, Any]) -> Config:
+    fields = {f.name for f in dataclasses.fields(Config)}
+    unknown = sorted(set(overrides) - fields)
+    if unknown:
+        raise ValueError(f"unknown Config fields in cell overrides: "
+                         f"{unknown}")
+    return base.replace(**overrides)
+
+
+def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
+              results_path: Optional[str] = None,
+              service_mode: bool = False) -> List[Dict[str, Any]]:
+    """Run every cell against one AOT bank; returns (and streams) one
+    result row per cell. ``service_mode`` routes cells through
+    service.driver.serve (supervised, journaled) instead of train.run."""
+    results_path = results_path or os.path.join(base_cfg.log_dir,
+                                                "queue_results.jsonl")
+    os.makedirs(os.path.dirname(results_path) or ".", exist_ok=True)
+    rows: List[Dict[str, Any]] = []
+    with open(results_path, "a", encoding="utf-8") as out:
+        for i, cell in enumerate(cells):
+            cfg = _apply_overrides(base_cfg, cell["overrides"])
+            if cfg.checkpoint_dir and "checkpoint_dir" not in cell["overrides"]:
+                # a shared checkpoint dir would make cell N resume cell
+                # N-1's journaled state (serve always resumes; same-shape
+                # one-shot cells cross-restore too) — isolate per cell
+                cfg = cfg.replace(checkpoint_dir=os.path.join(
+                    cfg.checkpoint_dir, cell["name"]))
+            print(f"[queue] cell {i + 1}/{len(cells)} {cell['name']!r}: "
+                  f"{cell['overrides']}")
+            row: Dict[str, Any] = {"cell": cell["name"],
+                                   "overrides": cell["overrides"],
+                                   "started": time.time()}
+            t0 = time.perf_counter()
+            try:
+                if service_mode:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.service.driver import (
+                        serve)
+                    summary = serve(cfg)
+                    row["service"] = summary.get("service")
+                else:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+                        run)
+                    summary = run(cfg)
+                row["summary"] = {k: summary[k] for k in SUMMARY_KEYS
+                                  if k in summary}
+                row["ok"] = True
+            except Exception as e:  # one poisoned cell != a dead matrix
+                row["ok"] = False
+                row["error"] = f"{type(e).__name__}: {e}"
+                print(f"[queue] cell {cell['name']!r} FAILED: "
+                      f"{row['error']} — continuing with the next cell")
+            row["wall_s"] = round(time.perf_counter() - t0, 3)
+            out.write(json.dumps(row) + "\n")
+            out.flush()   # a mid-queue kill keeps completed rows
+            rows.append(row)
+    done = sum(r["ok"] for r in rows)
+    print(f"[queue] {done}/{len(rows)} cells completed -> {results_path}")
+    return rows
+
+
+def main(argv=None) -> int:
+    # --queue (+ --service/--results) are queue-level; everything else is
+    # the shared base-config flag surface (config.args_parser)
+    qp = argparse.ArgumentParser(add_help=False)
+    qp.add_argument("--queue", required=True,
+                    help="JSON file of scenario cells (see module doc)")
+    qp.add_argument("--service", action="store_true",
+                    help="run cells through the supervised service driver "
+                         "instead of the one-shot trainer")
+    qp.add_argument("--results", default="",
+                    help="queue_results.jsonl path (default: <log_dir>/)")
+    qargs, rest = qp.parse_known_args(argv)
+    base_cfg = args_parser(rest)
+    if base_cfg.platform:
+        import jax
+        jax.config.update("jax_platforms", base_cfg.platform)
+    cells = load_cells(qargs.queue)
+    rows = run_queue(base_cfg, cells, results_path=qargs.results or None,
+                     service_mode=qargs.service)
+    return 0 if all(r["ok"] for r in rows) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,7 +7,16 @@ exact TensorBoard scalar names. Differences: the whole round is one compiled
 XLA program (vmap on one device, shard_map over the `agents` mesh axis when
 --mesh > 1); client sampling is seeded; checkpoint/resume via Orbax
 (SURVEY.md section 5.4 gap); rounds/sec throughput is measured (section 5.1
-gap, and BASELINE.json's headline metric)."""
+gap, and BASELINE.json's headline metric).
+
+Structure (ISSUE 6): all driver state lives in `RoundEngine`, a *resumable
+round engine* whose loop body is exposed as explicit steps —
+``dispatch(unit)`` / ``eval_boundary(rnd)`` / ``save_checkpoint(rnd)`` /
+``post_unit()`` — over engine state. ``run`` (the one-shot trainer) iterates
+them exactly as the historical monolithic loop did; the continuous-service
+driver (service/driver.py) iterates the same steps indefinitely with a
+supervisor wrapped around each one. The factoring is what makes crash-exact
+recovery possible: every step is re-enterable from restored state."""
 
 from __future__ import annotations
 
@@ -28,7 +37,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
     make_eval_fn, pad_eval_set)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-    FAULT_INFO_KEYS, host_takes_flags, make_round_fn, make_round_fn_host)
+    CHAINED_INFO_KEYS, FAULT_INFO_KEYS, host_takes_flags, make_round_fn,
+    make_round_fn_host)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
     telemetry as obs_telemetry)
@@ -68,7 +78,7 @@ def _adopt_aot(bank, cfg, family, jit_obj, example_args):
 
 def _bind_compiled(compiled, data):
     """Rebind an adopted executable to the bound-fn calling convention:
-    (params, key[, round_ids]) with the dataset stacks appended."""
+    (params, key[, round_idx]) with the dataset stacks appended."""
     def bound(params, key, *lead):
         return compiled(params, key, *lead, *data)
     return bound
@@ -116,476 +126,767 @@ def apply_rng_impl(choice: str) -> str:
     return impl
 
 
-def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
-    print_exp_details(cfg)
-    obs_telemetry.check_level(cfg.telemetry)
-    impl = apply_rng_impl(cfg.rng_impl)
-    if impl != "threefry2x32":
-        print(f"[rng] {impl} bit generator")
-    # observability (obs/): host-side round-trace spans + the status.json
-    # heartbeat, lead process only. The heartbeat rides the tracer's
-    # span-completion hook, so `last_span` tracks without extra calls.
-    lead = jax.process_index() == 0
-    hb = (Heartbeat(cfg.status_file
-                    or os.path.join(cfg.log_dir, "status.json"))
-          if cfg.heartbeat and lead else NullHeartbeat())
-    tracer = SpanTracer(enabled=cfg.spans and lead, on_end=hb.span_hook)
-    hb.update(phase="setup", rounds=cfg.rounds, force=True)
-    if cfg.telemetry != "off":
-        print(f"[telemetry] in-jit defense telemetry: {cfg.telemetry} "
-              f"(Defense/* scalars ride the metrics stream)")
-    # persistent XLA cache + AOT executable bank — must be configured
-    # before the first compile so every program family persists
-    bank = compile_cache.setup(cfg)
-    if cfg.compile_cache:
-        print(f"[cache] persistent XLA cache at "
-              f"{compile_cache.cache_root(cfg)}"
-              + ("" if bank is not None else " (AOT bank off: --debug_nan)"))
-    fed = get_federated_data(cfg)
-    if fed.synthetic and cfg.data != "synthetic":
-        print(f"[data] {cfg.data} files not found under {cfg.data_dir!r}; "
-              f"using the deterministic synthetic fallback")
+class RoundEngine:
+    """Resumable round engine: program building, restored state, and the
+    loop body as explicit re-enterable steps.
 
-    model = get_model(cfg.data, cfg.model_arch, cfg.dtype, remat=cfg.remat,
-                      remat_policy=cfg.remat_policy)
-    params = init_params(model, fed.train.images.shape[2:],
-                         jax.random.PRNGKey(cfg.seed))
-    print(f"[model] {type(model).__name__}: {param_count(params):,} params")
-    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    Construction does everything up to (not including) the first dispatch:
+    data/model/program building, AOT adoption, checkpoint restore, metrics
+    plumbing. The caller then drives:
 
-    # single source with the precompile planner (compile_cache.is_host_mode)
-    # so banked families always match what this loop dispatches; the
-    # threshold stays this module's global for test monkeypatching
-    host_mode = compile_cache.is_host_mode(cfg, fed,
-                                           threshold=DEVICE_RESIDENT_BYTES)
-    n_mesh = 1
-    if cfg.mesh != 1 and not host_mode:
-        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
-            make_mesh, pick_agent_mesh_size)
-        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-            make_sharded_round_fn)
-        n_mesh = pick_agent_mesh_size(cfg.mesh, cfg.agents_per_round)
+        for unit in engine.schedule():      # or its own unit stream
+            engine.dispatch(unit)
+            if engine.rnd % cfg.snap == 0:
+                engine.eval_boundary(engine.rnd)
+                engine.save_checkpoint(engine.rnd)   # if checkpointing
+            engine.post_unit()
+        ...
+        engine.close()                      # in a finally
+        summary = engine.finalize()
 
-    # diagnostics extras (lr vector, agent norms) are only consumed on snap
-    # rounds; off-snap rounds run a variant compiled without them
-    plain_cfg = cfg.replace(diagnostics=False)
-    host_sampler = None
-    chained_fn = None
-    host_chained_fn = None
-    get_unit = None     # host-mode payload fetch, defined in the host branch
-    prefetcher = None   # host-mode RoundPrefetcher, created lazily
-    # a diagnostic snap round always runs unchained, so it is excluded from
-    # the per-boundary chain budget (single source: utils/compile_cache —
-    # the precompile planner must agree with the driver on chain length)
-    chain_n = compile_cache.chain_budget(cfg)
-    if n_mesh > 1:
-        if jax.process_count() > 1:
-            # multi-host: one global agents mesh, DCN-aware device order.
-            # The mesh must span every host's devices, so the blocking
-            # policy cannot shrink it — the participant count has to divide
-            # over the full pod (global_agents_mesh raises otherwise).
-            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
-                multihost)
-            n_mesh = multihost.require_pod_divisible(
-                cfg.agents_per_round, "multi-host")
-            mesh = multihost.global_agents_mesh(n_mesh)
-            arrays = multihost.put_replicated(
-                mesh, (fed.train.images, fed.train.labels, fed.train.sizes))
-            params = multihost.put_replicated(mesh, params)
-        else:
-            mesh = make_mesh(n_mesh)
-            arrays = (jnp.asarray(fed.train.images),
-                      jnp.asarray(fed.train.labels),
-                      jnp.asarray(fed.train.sizes))
-        print(f"[mesh] {n_mesh} devices on the `agents` axis "
-              f"({cfg.agents_per_round // n_mesh} agents/device), "
-              f"{jax.process_count()} process(es)")
-        round_fn = make_sharded_round_fn(plain_cfg, model, norm, mesh, *arrays)
-        diag_round_fn = (make_sharded_round_fn(cfg, model, norm, mesh, *arrays)
-                         if cfg.diagnostics else round_fn)
-        if chain_n > 1:
-            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-                make_sharded_chained_round_fn)
-            chained_fn = make_sharded_chained_round_fn(
-                plain_cfg, model, norm, mesh, *arrays)
-    elif host_mode:
-        print(f"[data] host-sampled mode "
-              f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
-        # take(base, ids) materializes the round's sampled [m, ...] stack
-        # for this mode: the multi-process variant never gathers rows this
-        # process's devices don't own. take_block is the chained variant:
-        # ids [chain, m] -> [chain, m, ...] block in one placement.
-        take = lambda a, ids: jnp.asarray(a[ids])  # noqa: E731
-        take_block = take
-        round_fn_host = None
-        if cfg.mesh != 1 and jax.process_count() > 1:
-            # multi-process host-sampled: every process runs the identical
-            # seeded sampling over its (replicated) host dataset, then
-            # materializes only its addressable shards of the global
-            # [m, ...] stacks (multihost.take_agents_sharded); the
-            # shard_mapped round runs over ONE global agents mesh exactly
-            # like the device-resident multi-host path
-            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
-                multihost)
-            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-                make_sharded_round_fn_host)
-            n_mesh = multihost.require_pod_divisible(
-                cfg.agents_per_round, "multi-host host-sampled")
-            mesh = multihost.global_agents_mesh(0)
-            print(f"[mesh] {n_mesh} global devices on the `agents` axis "
-                  f"({cfg.agents_per_round // n_mesh} agents/device), "
-                  f"host-sampled shards, {jax.process_count()} processes")
-            take = lambda a, ids: multihost.take_agents_sharded(mesh, a, ids)  # noqa: E731
-            take_block = lambda a, ids: multihost.take_agents_sharded_block(  # noqa: E731
-                mesh, a, ids)
-            params = multihost.put_replicated(mesh, params)
-            round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
-                                                       norm, mesh)
-            diag_round_fn_host = (
-                make_sharded_round_fn_host(cfg, model, norm, mesh)
-                if cfg.diagnostics else round_fn_host)
-        elif cfg.mesh != 1:
-            # the m sampled shards gathered each round are fixed-shape
-            # [m, ...] stacks — partition them over the agents mesh (m/d
-            # per device) and run the shard_mapped round body
-            from jax.sharding import NamedSharding, PartitionSpec as P
+    ``run`` below is exactly that loop (the historical one-shot trainer);
+    service/driver.py wraps each step in a supervisor and streams units
+    indefinitely. State (params, base_key, rnd, cumulative metrics) lives
+    on the engine, so a crash resumes by building a fresh engine from the
+    journaled checkpoint (utils/checkpoint.py) and re-entering the loop —
+    bit-identical to never having crashed."""
+
+    def __init__(self, cfg: Config, writer: Optional[MetricsWriter] = None,
+                 resume_upto: Optional[int] = None):
+        # resume_upto pins the newest checkpoint round restore may pick
+        # (0 = none): the service driver passes its journal-agreed resume
+        # round so a kill between ckpt.save and journal_record cannot make
+        # the engine restore past the metrics splice point. None (the
+        # one-shot trainer) keeps newest-valid semantics. The producer
+        # (prepare_crash_exact_resume) has already digest-validated that
+        # round, so restore skips re-hashing it.
+        self.cfg = cfg
+        self._resume_upto = resume_upto
+        print_exp_details(cfg)
+        obs_telemetry.check_level(cfg.telemetry)
+        impl = apply_rng_impl(cfg.rng_impl)
+        if impl != "threefry2x32":
+            print(f"[rng] {impl} bit generator")
+        # observability (obs/): host-side round-trace spans + the
+        # status.json heartbeat, lead process only. The heartbeat rides the
+        # tracer's span-completion hook, so `last_span` tracks without
+        # extra calls.
+        self.lead = lead = jax.process_index() == 0
+        self.hb = hb = (Heartbeat(cfg.status_file
+                                  or os.path.join(cfg.log_dir,
+                                                  "status.json"))
+                        if cfg.heartbeat and lead else NullHeartbeat())
+        self.tracer = tracer = SpanTracer(enabled=cfg.spans and lead,
+                                          on_end=hb.span_hook)
+        hb.update(phase="setup", rounds=cfg.rounds, force=True)
+        if cfg.telemetry != "off":
+            print(f"[telemetry] in-jit defense telemetry: {cfg.telemetry} "
+                  f"(Defense/* scalars ride the metrics stream)")
+        # persistent XLA cache + AOT executable bank — must be configured
+        # before the first compile so every program family persists
+        bank = compile_cache.setup(cfg)
+        if cfg.compile_cache:
+            print(f"[cache] persistent XLA cache at "
+                  f"{compile_cache.cache_root(cfg)}"
+                  + ("" if bank is not None
+                     else " (AOT bank off: --debug_nan)"))
+        fed = get_federated_data(cfg)
+        if fed.synthetic and cfg.data != "synthetic":
+            print(f"[data] {cfg.data} files not found under "
+                  f"{cfg.data_dir!r}; using the deterministic synthetic "
+                  f"fallback")
+
+        model = get_model(cfg.data, cfg.model_arch, cfg.dtype,
+                          remat=cfg.remat, remat_policy=cfg.remat_policy)
+        params = init_params(model, fed.train.images.shape[2:],
+                             jax.random.PRNGKey(cfg.seed))
+        print(f"[model] {type(model).__name__}: "
+              f"{param_count(params):,} params")
+        norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+
+        # single source with the precompile planner
+        # (compile_cache.is_host_mode) so banked families always match what
+        # this loop dispatches; the threshold stays the module global for
+        # test monkeypatching
+        host_mode = compile_cache.is_host_mode(
+            cfg, fed, threshold=DEVICE_RESIDENT_BYTES)
+        n_mesh = 1
+        if cfg.mesh != 1 and not host_mode:
             from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
-                AGENTS_AXIS, make_mesh, pick_agent_mesh_size)
+                make_mesh, pick_agent_mesh_size)
             from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-                make_sharded_round_fn_host)
+                make_sharded_round_fn)
             n_mesh = pick_agent_mesh_size(cfg.mesh, cfg.agents_per_round)
-            if n_mesh > 1:
+
+        # diagnostics extras (lr vector, agent norms) are only consumed on
+        # snap rounds; off-snap rounds run a variant compiled without them
+        plain_cfg = cfg.replace(diagnostics=False)
+        host_sampler = None
+        chained_fn = None
+        host_chained_fn = None
+        get_unit = None   # host-mode payload fetch, set in the host branch
+        self._prefetcher = None   # host-mode RoundPrefetcher, created lazily
+        self._sched_units = None  # set by set_schedule (prefetch order)
+        # a diagnostic snap round always runs unchained, so it is excluded
+        # from the per-boundary chain budget (single source:
+        # utils/compile_cache — the precompile planner must agree with the
+        # driver on chain length)
+        chain_n = compile_cache.chain_budget(cfg)
+        mesh = None
+        if n_mesh > 1:
+            if jax.process_count() > 1:
+                # multi-host: one global agents mesh, DCN-aware device
+                # order. The mesh must span every host's devices, so the
+                # blocking policy cannot shrink it — the participant count
+                # has to divide over the full pod (global_agents_mesh
+                # raises otherwise).
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                    multihost)
+                n_mesh = multihost.require_pod_divisible(
+                    cfg.agents_per_round, "multi-host")
+                mesh = multihost.global_agents_mesh(n_mesh)
+                arrays = multihost.put_replicated(
+                    mesh, (fed.train.images, fed.train.labels,
+                           fed.train.sizes))
+                params = multihost.put_replicated(mesh, params)
+            else:
                 mesh = make_mesh(n_mesh)
-                print(f"[mesh] {n_mesh} devices on the `agents` axis "
-                      f"({cfg.agents_per_round // n_mesh} agents/device), "
-                      f"host-sampled shards")
-                agents_sharding = NamedSharding(mesh, P(AGENTS_AXIS))
-                block_sharding = NamedSharding(mesh, P(None, AGENTS_AXIS))
-                # device_put on the host array splits host->devices in one
-                # step (no staging copy through device 0)
-                take = lambda a, ids: jax.device_put(a[ids], agents_sharding)  # noqa: E731
-                take_block = lambda a, ids: jax.device_put(  # noqa: E731
-                    a[ids], block_sharding)
+                arrays = (jnp.asarray(fed.train.images),
+                          jnp.asarray(fed.train.labels),
+                          jnp.asarray(fed.train.sizes))
+            print(f"[mesh] {n_mesh} devices on the `agents` axis "
+                  f"({cfg.agents_per_round // n_mesh} agents/device), "
+                  f"{jax.process_count()} process(es)")
+            round_fn = make_sharded_round_fn(plain_cfg, model, norm, mesh,
+                                             *arrays)
+            diag_round_fn = (make_sharded_round_fn(cfg, model, norm, mesh,
+                                                   *arrays)
+                             if cfg.diagnostics else round_fn)
+            if chain_n > 1:
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                    make_sharded_chained_round_fn)
+                chained_fn = make_sharded_chained_round_fn(
+                    plain_cfg, model, norm, mesh, *arrays)
+        elif host_mode:
+            print(f"[data] host-sampled mode "
+                  f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
+            # take(base, ids) materializes the round's sampled [m, ...]
+            # stack for this mode: the multi-process variant never gathers
+            # rows this process's devices don't own. take_block is the
+            # chained variant: ids [chain, m] -> [chain, m, ...] block in
+            # one placement.
+            take = lambda a, ids: jnp.asarray(a[ids])  # noqa: E731
+            take_block = take
+            round_fn_host = None
+            if cfg.mesh != 1 and jax.process_count() > 1:
+                # multi-process host-sampled: every process runs the
+                # identical seeded sampling over its (replicated) host
+                # dataset, then materializes only its addressable shards
+                # of the global [m, ...] stacks
+                # (multihost.take_agents_sharded); the shard_mapped round
+                # runs over ONE global agents mesh exactly like the
+                # device-resident multi-host path
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                    multihost)
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                    make_sharded_round_fn_host)
+                n_mesh = multihost.require_pod_divisible(
+                    cfg.agents_per_round, "multi-host host-sampled")
+                mesh = multihost.global_agents_mesh(0)
+                print(f"[mesh] {n_mesh} global devices on the `agents` "
+                      f"axis ({cfg.agents_per_round // n_mesh} "
+                      f"agents/device), host-sampled shards, "
+                      f"{jax.process_count()} processes")
+                take = lambda a, ids: multihost.take_agents_sharded(  # noqa: E731
+                    mesh, a, ids)
+                take_block = lambda a, ids: \
+                    multihost.take_agents_sharded_block(  # noqa: E731
+                        mesh, a, ids)
+                params = multihost.put_replicated(mesh, params)
                 round_fn_host = make_sharded_round_fn_host(plain_cfg, model,
                                                            norm, mesh)
                 diag_round_fn_host = (
                     make_sharded_round_fn_host(cfg, model, norm, mesh)
                     if cfg.diagnostics else round_fn_host)
-            else:
-                print(f"[mesh] no device count <= {cfg.mesh or 'all'} "
-                      f"divides agents_per_round="
-                      f"{cfg.agents_per_round}; --mesh request ignored")
-        if round_fn_host is None:
-            round_fn_host = make_round_fn_host(plain_cfg, model, norm)
-            diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
-                                  if cfg.diagnostics else round_fn_host)
-        # one site builds the chained-host variant for whichever round fn
-        # was picked above (sharded single- or multi-process mesh, or
-        # single-device); a multi-process job WITHOUT the global mesh gets
-        # no chaining (it is the redundant-work warning case below).
-        # Host-sampled chaining is also skipped under faults: the host step
-        # then takes per-round corrupt flags the chained scan doesn't carry
-        # (device-resident chaining computes them in-jit and is unaffected).
-        if chain_n > 1 and cfg.faults_enabled:
-            chain_n = 1
-            print("[faults] host-sampled mode: --chain disabled (per-round "
-                  "corrupt flags ride each dispatch)")
-        if chain_n > 1:
-            if n_mesh > 1:
+            elif cfg.mesh != 1:
+                # the m sampled shards gathered each round are fixed-shape
+                # [m, ...] stacks — partition them over the agents mesh
+                # (m/d per device) and run the shard_mapped round body
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+                    AGENTS_AXIS, make_mesh, pick_agent_mesh_size)
                 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-                    make_sharded_chained_round_fn_host)
-                host_chained_fn = make_sharded_chained_round_fn_host(
-                    plain_cfg, model, norm, mesh)
-            elif jax.process_count() == 1:
-                from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-                    make_chained_round_fn_host)
-                host_chained_fn = make_chained_round_fn_host(plain_cfg,
-                                                             model, norm)
-
-        def sample_ids(rnd):
-            # per-round generator so --resume continues the same sampling
-            # sequence the uninterrupted run would have used
-            rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
-            return rng.choice(cfg.num_agents, cfg.agents_per_round,
-                              replace=False)
-
-        def gather_unit(unit):
-            """One dispatch unit's payload: a single round's [m, ...] stacks
-            or a chained block's [chain, m, ...] stacks (one placement).
-            The span lands on whichever thread runs the gather — the
-            prefetch worker in pipelined mode, so trace.json shows the
-            overlap."""
-            with tracer.span("prefetch/gather", rounds=len(unit)):
-                ids = np.stack([sample_ids(r) for r in unit])
-                if len(unit) == 1:
-                    return (ids[0], take(fed.train.images, ids[0]),
-                            take(fed.train.labels, ids[0]),
-                            take(fed.train.sizes, ids[0]))
-                return (ids, take_block(fed.train.images, ids),
-                        take_block(fed.train.labels, ids),
-                        take_block(fed.train.sizes, ids))
-
-        # host gather + H2D transfer overlap the running round program
-        # (data/prefetch.py); created lazily at the first dispatch so a
-        # resumed run prefetches from its restored start round
-        if cfg.host_prefetch > 0:
-            print(f"[prefetch] host->device pipeline, depth "
-                  f"{cfg.host_prefetch}")
-
-        def get_unit(unit):
-            nonlocal prefetcher
-            if cfg.host_prefetch > 0:
-                if prefetcher is None:
-                    # sched_units is THE loop's schedule (assigned before the
-                    # loop starts; the first get_unit call is its first
-                    # entry), so production order provably matches
-                    # consumption order
-                    from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
-                        RoundPrefetcher)
-                    prefetcher = RoundPrefetcher(gather_unit, sched_units,
-                                                 depth=cfg.host_prefetch)
-                return prefetcher.get(unit)
-            return gather_unit(unit)
-
-        def host_sampler(params, key, rnd, want_diag):
-            with tracer.span("round/data_prep", round=rnd):
-                ids, imgs, lbls, szs = get_unit((rnd,))
-            fn = diag_round_fn_host if want_diag else round_fn_host
-            with tracer.span("round/dispatch", round=rnd):
-                if host_takes_flags(cfg):
-                    # faults: the host-sampled ids determine which slots
-                    # hold malicious agents (--faults_spare_corrupt
-                    # participation); full telemetry: the honest/corrupt
-                    # cosine split needs the same flags
-                    flags = jnp.asarray(ids < cfg.num_corrupt)
-                    new_params, info = fn(params, key, imgs, lbls, szs,
-                                          flags)
+                    make_sharded_round_fn_host)
+                n_mesh = pick_agent_mesh_size(cfg.mesh,
+                                              cfg.agents_per_round)
+                if n_mesh > 1:
+                    mesh = make_mesh(n_mesh)
+                    print(f"[mesh] {n_mesh} devices on the `agents` axis "
+                          f"({cfg.agents_per_round // n_mesh} "
+                          f"agents/device), host-sampled shards")
+                    agents_sharding = NamedSharding(mesh, P(AGENTS_AXIS))
+                    block_sharding = NamedSharding(mesh,
+                                                   P(None, AGENTS_AXIS))
+                    # device_put on the host array splits host->devices in
+                    # one step (no staging copy through device 0)
+                    take = lambda a, ids: jax.device_put(  # noqa: E731
+                        a[ids], agents_sharding)
+                    take_block = lambda a, ids: jax.device_put(  # noqa: E731
+                        a[ids], block_sharding)
+                    round_fn_host = make_sharded_round_fn_host(
+                        plain_cfg, model, norm, mesh)
+                    diag_round_fn_host = (
+                        make_sharded_round_fn_host(cfg, model, norm, mesh)
+                        if cfg.diagnostics else round_fn_host)
                 else:
-                    new_params, info = fn(params, key, imgs, lbls, szs)
-            info["sampled"] = ids
-            return new_params, info
-    else:
-        arrays = (jnp.asarray(fed.train.images),
-                  jnp.asarray(fed.train.labels),
-                  jnp.asarray(fed.train.sizes))
-        round_fn = make_round_fn(plain_cfg, model, norm, *arrays)
-        diag_round_fn = (make_round_fn(cfg, model, norm, *arrays)
-                         if cfg.diagnostics else round_fn)
-        if chain_n > 1:
-            from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-                make_chained_round_fn)
-            chained_fn = make_chained_round_fn(plain_cfg, model, norm, *arrays)
-    if chained_fn is not None or host_chained_fn is not None:
-        print(f"[chain] {chain_n} rounds per compiled dispatch (lax.scan"
-              + (", host-sampled blocks)" if host_chained_fn is not None
-                 else ")"))
+                    print(f"[mesh] no device count <= {cfg.mesh or 'all'} "
+                          f"divides agents_per_round="
+                          f"{cfg.agents_per_round}; --mesh request ignored")
+            if round_fn_host is None:
+                round_fn_host = make_round_fn_host(plain_cfg, model, norm)
+                diag_round_fn_host = (make_round_fn_host(cfg, model, norm)
+                                      if cfg.diagnostics else round_fn_host)
+            # one site builds the chained-host variant for whichever round
+            # fn was picked above (sharded single- or multi-process mesh,
+            # or single-device); a multi-process job WITHOUT the global
+            # mesh gets no chaining (it is the redundant-work warning case
+            # below). Host-sampled chaining is also skipped under faults:
+            # the host step then takes per-round corrupt flags the chained
+            # scan doesn't carry (device-resident chaining computes them
+            # in-jit and is unaffected).
+            if chain_n > 1 and cfg.faults_enabled:
+                chain_n = 1
+                print("[faults] host-sampled mode: --chain disabled "
+                      "(per-round corrupt flags ride each dispatch)")
+            if chain_n > 1:
+                if n_mesh > 1:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                        make_sharded_chained_round_fn_host)
+                    host_chained_fn = make_sharded_chained_round_fn_host(
+                        plain_cfg, model, norm, mesh)
+                elif jax.process_count() == 1:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                        make_chained_round_fn_host)
+                    host_chained_fn = make_chained_round_fn_host(
+                        plain_cfg, model, norm)
 
-    if cfg.faults_enabled:
-        print(f"[faults] dropout={cfg.dropout_rate} "
-              f"straggler={cfg.straggler_rate}@{cfg.straggler_epochs}ep "
-              f"corrupt={cfg.corrupt_rate}/{cfg.corrupt_mode} "
-              f"norm_cap={cfg.payload_norm_cap} "
-              f"rlr_threshold={cfg.rlr_threshold_mode}"
-              + (" spare_corrupt" if cfg.faults_spare_corrupt else ""))
+            def sample_ids(rnd):
+                # per-round generator so --resume continues the same
+                # sampling sequence the uninterrupted run would have used
+                rng = np.random.default_rng(cfg.seed * 100_003 + rnd)
+                return rng.choice(cfg.num_agents, cfg.agents_per_round,
+                                  replace=False)
 
-    if jax.process_count() > 1 and n_mesh <= 1:
-        # no global-mesh SPMD path was taken: every process would run the
-        # identical seeded program independently — N-way duplicated work,
-        # not a distributed job (ADVICE r1)
-        print("[WARN] multi-process job without the global agents mesh: "
-              f"{jax.process_count()} processes are training REDUNDANTLY. "
-              "Set --mesh=0 (all devices) to distribute the round over "
-              "the pod.")
+            def gather_unit(unit):
+                """One dispatch unit's payload: a single round's [m, ...]
+                stacks or a chained block's [chain, m, ...] stacks (one
+                placement). The span lands on whichever thread runs the
+                gather — the prefetch worker in pipelined mode, so
+                trace.json shows the overlap."""
+                with tracer.span("prefetch/gather", rounds=len(unit)):
+                    ids = np.stack([sample_ids(r) for r in unit])
+                    if len(unit) == 1:
+                        return (ids[0], take(fed.train.images, ids[0]),
+                                take(fed.train.labels, ids[0]),
+                                take(fed.train.sizes, ids[0]))
+                    return (ids, take_block(fed.train.images, ids),
+                            take_block(fed.train.labels, ids),
+                            take_block(fed.train.sizes, ids))
 
-    if cfg.debug_nan:
-        # sanitizer mode (SURVEY.md section 5.2): float checks compiled into
-        # every round variant; raises on the first NaN/inf produced
-        print("[guards] checkify float checks enabled (--debug_nan)")
-        if host_sampler is None:
-            round_fn = guard_round_fn(round_fn)
-            diag_round_fn = guard_round_fn(diag_round_fn)
+            # host gather + H2D transfer overlap the running round program
+            # (data/prefetch.py); created lazily at the first dispatch so
+            # a resumed run prefetches from its restored start round
+            if cfg.host_prefetch > 0:
+                print(f"[prefetch] host->device pipeline, depth "
+                      f"{cfg.host_prefetch}")
+
+            def get_unit(unit):
+                if cfg.host_prefetch > 0:
+                    if self._prefetcher is None:
+                        # _sched_units is THE loop's schedule (set before
+                        # the loop starts; the first get_unit call is its
+                        # first entry), so production order provably
+                        # matches consumption order
+                        from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+                            RoundPrefetcher)
+                        self._prefetcher = RoundPrefetcher(
+                            gather_unit, self._sched_units,
+                            depth=cfg.host_prefetch)
+                    return self._prefetcher.get(unit)
+                return gather_unit(unit)
+
+            def host_sampler(params, key, rnd, want_diag):
+                with tracer.span("round/data_prep", round=rnd):
+                    ids, imgs, lbls, szs = get_unit((rnd,))
+                fn = diag_round_fn_host if want_diag else round_fn_host
+                with tracer.span("round/dispatch", round=rnd):
+                    if host_takes_flags(cfg):
+                        # faults: the host-sampled ids determine which
+                        # slots hold malicious agents
+                        # (--faults_spare_corrupt participation); full
+                        # telemetry: the honest/corrupt cosine split needs
+                        # the same flags
+                        flags = jnp.asarray(ids < cfg.num_corrupt)
+                        new_params, info = fn(params, key, imgs, lbls, szs,
+                                              flags)
+                    else:
+                        new_params, info = fn(params, key, imgs, lbls, szs)
+                info["sampled"] = ids
+                return new_params, info
         else:
-            round_fn_host = guard_round_fn(round_fn_host)
-            diag_round_fn_host = guard_round_fn(diag_round_fn_host)
-        if chained_fn is not None:
-            chained_fn = guard_round_fn(chained_fn)
-        if host_chained_fn is not None:
-            host_chained_fn = guard_round_fn(host_chained_fn)
+            arrays = (jnp.asarray(fed.train.images),
+                      jnp.asarray(fed.train.labels),
+                      jnp.asarray(fed.train.sizes))
+            round_fn = make_round_fn(plain_cfg, model, norm, *arrays)
+            diag_round_fn = (make_round_fn(cfg, model, norm, *arrays)
+                             if cfg.diagnostics else round_fn)
+            if chain_n > 1:
+                from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                    make_chained_round_fn)
+                chained_fn = make_chained_round_fn(plain_cfg, model, norm,
+                                                   *arrays)
+        if chained_fn is not None or host_chained_fn is not None:
+            print(f"[chain] {chain_n} rounds per compiled dispatch "
+                  f"(lax.scan"
+                  + (", host-sampled blocks)" if host_chained_fn is not None
+                     else ")"))
 
-    if cfg.use_pallas:
-        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-            _pallas_applicable)
-        if n_mesh > 1 and _pallas_applicable(plain_cfg):
-            print("[pallas] sharded fused server step: one Pallas pass per "
-                  "device + psum of the sign/avg partials")
-        elif _pallas_applicable(plain_cfg):
-            msg = "[pallas] fused RLR+FedAvg+apply server kernel enabled"
-            if cfg.diagnostics:
-                msg += (" (snap rounds use the jnp path: diagnostics need "
-                        "the explicit lr vector)")
-            print(msg)
-        else:
-            print(f"[pallas] fused kernel covers aggr=avg/sign with "
-                  f"noise=0; aggr={cfg.aggr!r} noise={cfg.noise} falls back "
-                  f"to the jnp path")
+        if cfg.faults_enabled:
+            print(f"[faults] dropout={cfg.dropout_rate} "
+                  f"straggler={cfg.straggler_rate}@{cfg.straggler_epochs}ep "
+                  f"corrupt={cfg.corrupt_rate}/{cfg.corrupt_mode} "
+                  f"norm_cap={cfg.payload_norm_cap} "
+                  f"rlr_threshold={cfg.rlr_threshold_mode}"
+                  + (" spare_corrupt" if cfg.faults_spare_corrupt else ""))
+        if cfg.churn_enabled:
+            print(f"[churn] client lifecycles: available "
+                  f"{cfg.churn_available} of phases, period "
+                  f"{cfg.churn_period} rounds, churn_seed {cfg.churn_seed} "
+                  f"(service/churn.py; away clients ride the "
+                  f"participation mask)")
 
-    eval_fn = make_eval_fn(model, norm, cfg.n_classes)
-    fisher_fn = None
-    if cfg.diagnostics:
-        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
-            make_fisher_fn, norm_scalars, sign_agreement)
-        fisher_fn = make_fisher_fn(model, norm)
-    val = tuple(map(jnp.asarray, pad_eval_set(
-        fed.val_images, fed.val_labels, cfg.eval_bs)))
-    pval = tuple(map(jnp.asarray, pad_eval_set(
-        fed.pval_images, fed.pval_labels, cfg.eval_bs)))
+        if jax.process_count() > 1 and n_mesh <= 1:
+            # no global-mesh SPMD path was taken: every process would run
+            # the identical seeded program independently — N-way duplicated
+            # work, not a distributed job (ADVICE r1)
+            print("[WARN] multi-process job without the global agents "
+                  f"mesh: {jax.process_count()} processes are training "
+                  "REDUNDANTLY. Set --mesh=0 (all devices) to distribute "
+                  "the round over the pod.")
 
-    if writer is None:
-        writer = (MetricsWriter(cfg.log_dir, run_name(cfg), cfg.tensorboard)
-                  if lead else NullWriter())
-
-    base_key = jax.random.PRNGKey(cfg.seed)
-
-    start_round, cum_poison_acc, cum_net_mov = 0, 0.0, 0.0
-    if cfg.resume and cfg.checkpoint_dir:
-        restored = ckpt.restore(cfg.checkpoint_dir, params)
-        if restored is not None:
-            start_round, params, base_key, cum_poison_acc, cum_net_mov = \
-                restored
-            if jax.process_count() > 1 and n_mesh > 1:
-                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
-                    multihost)
-                params = multihost.put_replicated(mesh, params)
+        if cfg.debug_nan:
+            # sanitizer mode (SURVEY.md section 5.2): float checks compiled
+            # into every round variant; raises on the first NaN/inf
+            print("[guards] checkify float checks enabled (--debug_nan)")
+            if host_sampler is None:
+                round_fn = guard_round_fn(round_fn)
+                diag_round_fn = guard_round_fn(diag_round_fn)
             else:
-                params = jax.device_put(params)
-            print(f"[ckpt] resumed from round {start_round}")
+                round_fn_host = guard_round_fn(round_fn_host)
+                diag_round_fn_host = guard_round_fn(diag_round_fn_host)
+            if chained_fn is not None:
+                chained_fn = guard_round_fn(chained_fn)
+            if host_chained_fn is not None:
+                host_chained_fn = guard_round_fn(host_chained_fn)
 
-    # --- AOT adoption: swap jitted program families for banked serialized
-    # executables (utils/compile_cache.py). A warm start skips XLA
-    # entirely; a cold start compiles ahead-of-time and banks the result.
-    # Scope: single-process, single-device programs only — sharded round
-    # fns produce mesh-replicated params whose shardings a Compiled lowered
-    # from plain avals rejects at call time, and multi-process executables
-    # embed the local topology; both keep plain jit, which still
-    # warm-starts through the persistent XLA cache. Any per-family failure
-    # also falls back to jit.
-    eval_val_fn = eval_pval_fn = eval_fn
-    # the stall detectors must not kill a first-time compile (the
-    # documented tunnel-wedge cause): flag the compile window until the
-    # first dispatch unit has executed
-    hb.update(phase="compile", compile_in_flight=True, force=True)
-    if bank is not None and jax.process_count() == 1 and n_mesh == 1:
-        ab = compile_cache.abstractify
-        p_aval, k_aval = ab(params), ab(base_key)
-        ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
-        if host_sampler is not None:
-            m = cfg.agents_per_round
-            shard_avals = tuple(
-                jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
-                for a in (fed.train.images, fed.train.labels,
-                          fed.train.sizes))
-            flag_avals = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
-                          if host_takes_flags(cfg) else ())
-            shared = diag_round_fn_host is round_fn_host
-            fn = _adopt_aot(bank, cfg, "round_host", round_fn_host,
-                            (p_aval, k_aval) + shard_avals + flag_avals)
-            if fn is not None:
-                round_fn_host = fn
-                if shared:
-                    diag_round_fn_host = fn
-            if cfg.diagnostics:
-                fn = _adopt_aot(bank, cfg, "round_host_diag",
-                                diag_round_fn_host,
+        if cfg.use_pallas:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                _pallas_applicable)
+            if n_mesh > 1 and _pallas_applicable(plain_cfg):
+                print("[pallas] sharded fused server step: one Pallas pass "
+                      "per device + psum of the sign/avg partials")
+            elif _pallas_applicable(plain_cfg):
+                msg = "[pallas] fused RLR+FedAvg+apply server kernel enabled"
+                if cfg.diagnostics:
+                    msg += (" (snap rounds use the jnp path: diagnostics "
+                            "need the explicit lr vector)")
+                print(msg)
+            else:
+                print(f"[pallas] fused kernel covers aggr=avg/sign with "
+                      f"noise=0; aggr={cfg.aggr!r} noise={cfg.noise} falls "
+                      f"back to the jnp path")
+
+        eval_fn = make_eval_fn(model, norm, cfg.n_classes)
+        self._fisher_fn = None
+        if cfg.diagnostics:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
+                make_fisher_fn)
+            self._fisher_fn = make_fisher_fn(model, norm)
+        val = tuple(map(jnp.asarray, pad_eval_set(
+            fed.val_images, fed.val_labels, cfg.eval_bs)))
+        pval = tuple(map(jnp.asarray, pad_eval_set(
+            fed.pval_images, fed.pval_labels, cfg.eval_bs)))
+
+        if writer is None:
+            writer = (MetricsWriter(cfg.log_dir, run_name(cfg),
+                                    cfg.tensorboard)
+                      if lead else NullWriter())
+        self.writer = writer
+
+        base_key = jax.random.PRNGKey(cfg.seed)
+
+        start_round, cum_poison_acc, self.cum_net_mov = 0, 0.0, 0.0
+        if cfg.resume and cfg.checkpoint_dir:
+            restored = ckpt.restore(
+                cfg.checkpoint_dir, params, upto=self._resume_upto,
+                upto_validated=self._resume_upto is not None)
+            if restored is not None:
+                (start_round, params, base_key, cum_poison_acc,
+                 self.cum_net_mov) = restored
+                if jax.process_count() > 1 and n_mesh > 1:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                        multihost)
+                    params = multihost.put_replicated(mesh, params)
+                else:
+                    params = jax.device_put(params)
+                print(f"[ckpt] resumed from round {start_round}")
+
+        # --- AOT adoption: swap jitted program families for banked
+        # serialized executables (utils/compile_cache.py). A warm start
+        # skips XLA entirely; a cold start compiles ahead-of-time and banks
+        # the result. Scope: single-process, single-device programs only —
+        # sharded round fns produce mesh-replicated params whose shardings
+        # a Compiled lowered from plain avals rejects at call time, and
+        # multi-process executables embed the local topology; both keep
+        # plain jit, which still warm-starts through the persistent XLA
+        # cache. Any per-family failure also falls back to jit.
+        eval_val_fn = eval_pval_fn = eval_fn
+        # the stall detectors must not kill a first-time compile (the
+        # documented tunnel-wedge cause): flag the compile window until the
+        # first dispatch unit has executed
+        hb.update(phase="compile", compile_in_flight=True, force=True)
+        if bank is not None and jax.process_count() == 1 and n_mesh == 1:
+            ab = compile_cache.abstractify
+            p_aval, k_aval = ab(params), ab(base_key)
+            ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
+            # churn round programs take the round index as a traced int32
+            # scalar (service/churn.py; single source with plan_programs)
+            lead_avals = ((jax.ShapeDtypeStruct((), jnp.int32),)
+                          if cfg.churn_enabled else ())
+            if host_sampler is not None:
+                m = cfg.agents_per_round
+                shard_avals = tuple(
+                    jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
+                    for a in (fed.train.images, fed.train.labels,
+                              fed.train.sizes))
+                flag_avals = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
+                              if host_takes_flags(cfg) else ())
+                shared = diag_round_fn_host is round_fn_host
+                fn = _adopt_aot(bank, cfg, "round_host", round_fn_host,
                                 (p_aval, k_aval) + shard_avals + flag_avals)
                 if fn is not None:
-                    diag_round_fn_host = fn
-            if host_chained_fn is not None:
-                block_avals = tuple(
-                    jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
-                    for a in shard_avals)
-                fn = _adopt_aot(bank, cfg, "chained_host", host_chained_fn,
-                                (p_aval, k_aval, ids_aval) + block_avals)
+                    round_fn_host = fn
+                    if shared:
+                        diag_round_fn_host = fn
+                if cfg.diagnostics:
+                    fn = _adopt_aot(bank, cfg, "round_host_diag",
+                                    diag_round_fn_host,
+                                    (p_aval, k_aval) + shard_avals
+                                    + flag_avals)
+                    if fn is not None:
+                        diag_round_fn_host = fn
+                if host_chained_fn is not None:
+                    block_avals = tuple(
+                        jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
+                        for a in shard_avals)
+                    fn = _adopt_aot(bank, cfg, "chained_host",
+                                    host_chained_fn,
+                                    (p_aval, k_aval, ids_aval)
+                                    + block_avals)
+                    if fn is not None:
+                        host_chained_fn = fn
+            else:
+                data_avals = ab(arrays)
+                fn = _adopt_aot(bank, cfg, round_fn.family, round_fn.jitted,
+                                (p_aval, k_aval) + lead_avals + data_avals)
                 if fn is not None:
-                    host_chained_fn = fn
-        else:
-            data_avals = ab(arrays)
-            fn = _adopt_aot(bank, cfg, round_fn.family, round_fn.jitted,
-                            (p_aval, k_aval) + data_avals)
+                    round_fn = _bind_compiled(fn, round_fn.data)
+                    if not cfg.diagnostics:
+                        diag_round_fn = round_fn
+                if cfg.diagnostics:
+                    fn = _adopt_aot(bank, cfg, diag_round_fn.family,
+                                    diag_round_fn.jitted,
+                                    (p_aval, k_aval) + lead_avals
+                                    + data_avals)
+                    if fn is not None:
+                        diag_round_fn = _bind_compiled(fn,
+                                                       diag_round_fn.data)
+                if chained_fn is not None:
+                    fn = _adopt_aot(bank, cfg, chained_fn.family,
+                                    chained_fn.jitted,
+                                    (p_aval, k_aval, ids_aval) + data_avals)
+                    if fn is not None:
+                        chained_fn = _bind_compiled(fn, chained_fn.data)
+            fn = _adopt_aot(bank, cfg, "eval_val", eval_fn,
+                            (p_aval,) + ab(val))
             if fn is not None:
-                round_fn = _bind_compiled(fn, round_fn.data)
-                if not cfg.diagnostics:
-                    diag_round_fn = round_fn
-            if cfg.diagnostics:
-                fn = _adopt_aot(bank, cfg, diag_round_fn.family,
-                                diag_round_fn.jitted,
-                                (p_aval, k_aval) + data_avals)
-                if fn is not None:
-                    diag_round_fn = _bind_compiled(fn, diag_round_fn.data)
-            if chained_fn is not None:
-                fn = _adopt_aot(bank, cfg, chained_fn.family,
-                                chained_fn.jitted,
-                                (p_aval, k_aval, ids_aval) + data_avals)
-                if fn is not None:
-                    chained_fn = _bind_compiled(fn, chained_fn.data)
-        fn = _adopt_aot(bank, cfg, "eval_val", eval_fn, (p_aval,) + ab(val))
-        if fn is not None:
-            eval_val_fn = fn
-        fn = _adopt_aot(bank, cfg, "eval_poison", eval_fn,
-                        (p_aval,) + ab(pval))
-        if fn is not None:
-            eval_pval_fn = fn
+                eval_val_fn = fn
+            fn = _adopt_aot(bank, cfg, "eval_poison", eval_fn,
+                            (p_aval,) + ab(pval))
+            if fn is not None:
+                eval_pval_fn = fn
 
+        # sampled device-trace window (--profile_rounds N,
+        # obs/attribution.py): opens at the first STEADY dispatch unit
+        # (never the compile unit), closes after N rounds, and is parsed
+        # into Device/* + Memory/* attribution rows after the loop. A bare
+        # --profile_dir (without --profile_rounds) keeps its historical
+        # whole-run trace semantics.
+        self.prof = None
+        if cfg.profile_rounds > 0 and lead:
+            run_dir_hint = getattr(writer, "dir", None) or cfg.log_dir
+            self.prof = obs_attribution.RoundProfiler(
+                cfg.profile_rounds,
+                cfg.profile_dir or os.path.join(run_dir_hint, "profile"))
+        self._whole_run_trace = bool(cfg.profile_dir and lead
+                                     and self.prof is None)
+        if self._whole_run_trace:
+            jax.profiler.start_trace(cfg.profile_dir)
 
-    # sampled device-trace window (--profile_rounds N, obs/attribution.py):
-    # opens at the first STEADY dispatch unit (never the compile unit),
-    # closes after N rounds, and is parsed into Device/* + Memory/*
-    # attribution rows after the loop. A bare --profile_dir (without
-    # --profile_rounds) keeps its historical whole-run trace semantics.
-    prof = None
-    if cfg.profile_rounds > 0 and lead:
-        run_dir_hint = getattr(writer, "dir", None) or cfg.log_dir
-        prof = obs_attribution.RoundProfiler(
-            cfg.profile_rounds,
-            cfg.profile_dir or os.path.join(run_dir_hint, "profile"))
-    if cfg.profile_dir and lead and prof is None:
-        jax.profiler.start_trace(cfg.profile_dir)
+        # --- async metrics pipeline: per-round/eval scalars stay on device
+        # and drain through a background thread's batched device_get, so
+        # the round loop never blocks on a host sync (~24% of round time on
+        # the small CNN, r3 flagship ladder). Diagnostics and --debug_nan
+        # need inline host values; multi-process jobs keep the lead-only
+        # writer synchronous.
+        use_async = (cfg.async_metrics and not cfg.debug_nan
+                     and not cfg.diagnostics and jax.process_count() == 1)
+        self.drain = MetricsDrain(tracer=tracer) if use_async else None
+        if self.drain is not None:
+            print("[metrics] async drain: host syncs ride a background "
+                  "thread (--sync_metrics restores the inline path)")
+        # steady-state clock (VERDICT r1 #9): stamped in emit_eval, i.e.
+        # when a boundary's values ARRIVE (post-execution) — in async mode
+        # the dispatch timestamps would measure queueing, not compute
+        self.mstate = {"cum_poison_acc": cum_poison_acc, "summary": {},
+                       "t_steady": None, "r_steady": 0,
+                       "t_steady_end": None, "r_steady_end": 0}
 
-    # --- async metrics pipeline: per-round/eval scalars stay on device and
-    # drain through a background thread's batched device_get, so the round
-    # loop never blocks on a host sync (~24% of round time on the small CNN,
-    # r3 flagship ladder). Diagnostics and --debug_nan need inline host
-    # values; multi-process jobs keep the lead-only writer synchronous.
-    use_async = (cfg.async_metrics and not cfg.debug_nan
-                 and not cfg.diagnostics and jax.process_count() == 1)
-    drain = MetricsDrain(tracer=tracer) if use_async else None
-    if drain is not None:
-        print("[metrics] async drain: host syncs ride a background thread "
-              "(--sync_metrics restores the inline path)")
-    # steady-state clock (VERDICT r1 #9): stamped in emit_eval, i.e. when a
-    # boundary's values ARRIVE (post-execution) — in async mode the dispatch
-    # timestamps would measure queueing, not compute
-    mstate = {"cum_poison_acc": cum_poison_acc, "summary": {},
-              "t_steady": None, "r_steady": 0,
-              "t_steady_end": None, "r_steady_end": 0}
+        # engine state the step methods advance
+        self.params = params
+        self.base_key = base_key
+        self.start_round = start_round
+        self.rnd = start_round
+        self.rounds_done = 0
+        self.first_unit = True
+        self.chain_n = chain_n
+        self.n_mesh = n_mesh
+        self.host_mode = host_mode
+        self.val, self.pval = val, pval
+        self._round_fn, self._diag_round_fn = (
+            (round_fn, diag_round_fn) if host_sampler is None
+            else (None, None))
+        self._host_sampler = host_sampler
+        self._get_unit_impl = get_unit
+        self._chained_fn, self._host_chained_fn = chained_fn, host_chained_fn
+        self._eval_val_fn, self._eval_pval_fn = eval_val_fn, eval_pval_fn
+        self._last_info = {}
+        self._want_diag = False
+        self._prev_params = None
+        self.t_loop = time.perf_counter()
 
-    def emit_eval(vals, ernd, rounds_done_now, elapsed):
+    # ------------------------------------------------------------- schedule
+
+    @property
+    def chaining(self) -> bool:
+        return (self._chained_fn is not None
+                or self._host_chained_fn is not None)
+
+    def schedule(self):
+        """The one-shot dispatch plan from the engine's (restored) start
+        round to cfg.rounds. ONE source of truth for chaining decisions:
+        the loop consumes the same schedule the host-mode prefetcher
+        produces against, so the two cannot desynchronize (code review
+        r3)."""
+        units = dispatch_schedule(
+            self.start_round, self.cfg.rounds, self.cfg.snap, self.chain_n,
+            self.cfg.diagnostics, self.chaining)
+        self.set_schedule(units)
+        return units
+
+    def set_schedule(self, units) -> None:
+        """Pin the unit stream the host-mode prefetcher will produce
+        against (any iterable of round-id tuples; the service driver
+        passes a generator). Must be called before the first dispatch."""
+        self._sched_units = units
+
+    # ------------------------------------------------------------- stepping
+
+    def _churn_lead(self, rnd):
+        return ((jnp.int32(rnd),) if self.cfg.churn_enabled else ())
+
+    def dispatch(self, unit) -> None:
+        """Run one dispatch unit (a single round or a chained block):
+        advances params/rnd/rounds_done, records spans/heartbeat, feeds
+        the profiler, and emits the snap-round diagnostics scalars."""
+        cfg, tracer = self.cfg, self.tracer
+        self.hb.update(phase="train", round=unit[-1])
+        if self.prof is not None and not self.first_unit:
+            # steady state: every hot-path program compiled during the
+            # first unit, so the window never captures XLA working
+            self.prof.maybe_start()
+        if len(unit) > 1:
+            # chained block: fixed length => one compilation per shape
+            with tracer.span("round/data_prep", round=unit[-1]):
+                ids = jnp.arange(unit[0], unit[-1] + 1)
+                payload = (None if self._chained_fn is not None
+                           else self._get_unit(unit))
+            with tracer.span("round/dispatch", round=unit[-1],
+                             chain=len(unit)):
+                if self._chained_fn is not None:
+                    self.params, stacked = self._chained_fn(
+                        self.params, self.base_key, ids)
+                else:
+                    # host-sampled block: the prefetcher hands over the
+                    # whole [chain, m, ...] shard-stack payload at once
+                    _, imgs, lbls, szs = payload
+                    self.params, stacked = self._host_chained_fn(
+                        self.params, self.base_key, ids, imgs, lbls, szs)
+            self.rnd = unit[-1]
+            self.rounds_done += len(unit)
+            info = {"train_loss": stacked["train_loss"][-1]}
+            info.update({k: stacked[k][-1] for k in CHAINED_INFO_KEYS
+                         if k in stacked})
+            info.update({k: stacked[k][-1] for k in stacked
+                         if k.startswith("tel_")})
+            self._want_diag, self._prev_params = False, None
+        else:
+            rnd = unit[0]
+            with tracer.span("round/data_prep", round=rnd):
+                key = jax.random.fold_in(self.base_key, rnd)
+                snap_round = rnd % cfg.snap == 0
+                self._want_diag = cfg.diagnostics and snap_round
+                self._prev_params = self.params if self._want_diag else None
+            if self._host_sampler is not None:
+                # host_sampler opens its own data_prep/dispatch spans (the
+                # gather is the interesting part there)
+                self.params, info = self._host_sampler(
+                    self.params, key, rnd, self._want_diag)
+            else:
+                with tracer.span("round/dispatch", round=rnd):
+                    fn = (self._diag_round_fn if self._want_diag
+                          else self._round_fn)
+                    self.params, info = fn(self.params, key,
+                                           *self._churn_lead(rnd))
+            self.rnd = rnd
+            self.rounds_done += 1
+        self._last_info = info
+        if self.prof is not None:
+            # accounts the unit toward the capture budget and polls the
+            # HBM watermarks; closes the window (blocking on params first)
+            # once the budget is reached
+            self.prof.after_unit(self.params, len(unit))
+        if self._want_diag:
+            self._emit_diagnostics(info)
+
+    def _get_unit(self, unit):
+        if self._get_unit_impl is None:
+            raise RuntimeError("host payload requested outside host mode")
+        # the host branch's get_unit closure (set in __init__)
+        return self._get_unit_impl(unit)
+
+    def _emit_diagnostics(self, info) -> None:
+        cfg, writer, rnd = self.cfg, self.writer, self.rnd
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
+            norm_scalars, sign_agreement)
+        if "agent_norms" in info:
+            for tag, v in norm_scalars(info["agent_norms"],
+                                       info["sampled"],
+                                       cfg.num_corrupt).items():
+                writer.scalar(tag, v, rnd)
+        if "lr_flat" in info:
+            from jax.flatten_util import ravel_pytree
+            pval = self.pval
+            # Fisher at the pre-update params (aggregation.py:146-148)
+            f_adv = ravel_pytree(self._fisher_fn(self._prev_params,
+                                                 *pval))[0]
+            hon_labels = jnp.full_like(pval[1], cfg.base_class)
+            f_hon = ravel_pytree(
+                self._fisher_fn(self._prev_params, pval[0], hon_labels,
+                                pval[2]))[0]
+            upd_flat = (ravel_pytree(self.params)[0]
+                        - ravel_pytree(self._prev_params)[0])
+            # --diagnostics is the synchronous research mode by design
+            # (the async drain is disabled); these fetches happen at snap
+            # cadence only.
+            # static: ok(host-sync)
+            scalars, self.cum_net_mov = sign_agreement(
+                np.asarray(info["lr_flat"]), np.asarray(upd_flat),
+                np.asarray(f_adv), np.asarray(f_hon),
+                cfg.top_frac, cfg.effective_server_lr, self.cum_net_mov)
+            for tag, v in scalars.items():
+                writer.scalar(tag, v, rnd)
+
+    def eval_boundary(self, rnd: int) -> None:
+        """One eval boundary: dispatch the two eval programs on the
+        (un-donated) params and route the values through the async drain
+        (or emit inline in sync mode)."""
+        cfg, tracer, info = self.cfg, self.tracer, self._last_info
+        # HBM watermarks ride the heartbeat so the session stall detectors
+        # see memory pressure, not just phase ({} on backends without
+        # allocator stats)
+        self.hb.update(phase="eval", round=rnd,
+                       **obs_attribution.memory_watermarks())
+        # divergence aborts only under --debug_nan (sync mode); otherwise
+        # the finite check rides the drain and warns, and the run keeps
+        # recording its (NaN) metrics
+        vals = {"finite": all_finite_device(self.params)}
+        # eval dispatches on the (un-donated) params BEFORE the next
+        # dispatch unit runs: in async mode round r's eval executes
+        # overlapped with the round r+1 training block
+        with tracer.span("eval/val_dispatch", round=rnd):
+            val_loss_d, val_acc_d, per_class_d = self._eval_val_fn(
+                self.params, *self.val)
+        with tracer.span("eval/poison_dispatch", round=rnd):
+            poison_loss_d, poison_acc_d, _ = self._eval_pval_fn(
+                self.params, *self.pval)
+        vals.update(val_loss=val_loss_d, val_acc=val_acc_d,
+                    base_acc=per_class_d[cfg.base_class],
+                    poison_loss=poison_loss_d,
+                    poison_acc=poison_acc_d,
+                    train_loss=info["train_loss"])
+        if "fault_voters" in info:
+            vals.update({k: info[k] for k in FAULT_INFO_KEYS})
+        if "churn_away" in info:
+            vals["churn_away"] = info["churn_away"]
+        # in-jit defense telemetry rides the same (async) fetch
+        vals.update({k: info[k] for k in info if k.startswith("tel_")})
+        if self.drain is not None:
+            elapsed = time.perf_counter() - self.t_loop
+            self.drain.submit(self._emit_eval, vals, rnd, self.rounds_done,
+                              elapsed)
+        else:
+            with tracer.span("metrics/host_sync", round=rnd):
+                # this IS the --sync_metrics fallback path; async mode
+                # routes the same fetch through the MetricsDrain instead.
+                # static: ok(host-sync)
+                vals = jax.device_get(vals)  # THE per-round sync
+            elapsed = time.perf_counter() - self.t_loop
+            self._emit_eval(vals, rnd, self.rounds_done, elapsed)
+
+    def _emit_eval(self, vals, ernd, rounds_done_now, elapsed):
         """One eval boundary's host side-effects, in the exact synchronous
         order. Sync mode calls it inline with fetched values; async mode
         runs it on the drain thread — one code path, so metrics.jsonl is
         bit-identical between the modes (tests/test_async_metrics.py).
         The cumulative poison mean accumulates HERE in host float64,
         matching the synchronous semantics exactly."""
-        with tracer.span("metrics/emit", round=ernd):
-            _emit_eval_body(vals, ernd, rounds_done_now, elapsed)
+        with self.tracer.span("metrics/emit", round=ernd):
+            self._emit_eval_body(vals, ernd, rounds_done_now, elapsed)
 
-    def _emit_eval_body(vals, ernd, rounds_done_now, elapsed):
+    def _emit_eval_body(self, vals, ernd, rounds_done_now, elapsed):
+        cfg, writer, mstate = self.cfg, self.writer, self.mstate
         finite_warn(vals["finite"], where=f"round {ernd}",
                     raise_error=cfg.debug_nan)
         val_loss = float(vals["val_loss"])
         val_acc = float(vals["val_acc"])
         poison_loss = float(vals["poison_loss"])
         poison_acc = float(vals["poison_acc"])
-        mstate["cum_poison_acc"] += poison_acc
+        # computed into a local and committed to mstate only at the very
+        # end: the service supervisor retries a transiently-failed eval
+        # unit by re-running this body, and an accumulate-first ordering
+        # would double-count poison_acc into the checkpointed cumulative
+        cum_poison_acc = mstate["cum_poison_acc"] + poison_acc
         # scalar names preserved from src/federated.py:81-91
         writer.scalar("Validation/Loss", val_loss, ernd)
         writer.scalar("Validation/Accuracy", val_acc, ernd)
@@ -594,17 +895,20 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
         writer.scalar("Poison/Poison_Accuracy", poison_acc, ernd)
         writer.scalar("Poison/Poison_Loss", poison_loss, ernd)
         writer.scalar("Poison/Cumulative_Poison_Accuracy_Mean",
-                      mstate["cum_poison_acc"] / ernd, ernd)
+                      cum_poison_acc / ernd, ernd)
         writer.scalar("Train/Loss", float(vals["train_loss"]), ernd)
         if "fault_voters" in vals:
-            # degradation observability (faults/): who failed this round,
-            # and how thin the aggregation electorate got
+            # degradation observability (faults/ + service/churn.py): who
+            # failed this round, and how thin the electorate got
             writer.scalar("Faults/Dropped",
                           float(vals["fault_dropped"]), ernd)
             writer.scalar("Faults/Straggled",
                           float(vals["fault_straggled"]), ernd)
             writer.scalar("Faults/Effective_Voters",
                           float(vals["fault_voters"]), ernd)
+        if "churn_away" in vals:
+            writer.scalar("Churn/Sampled_Away",
+                          float(vals["churn_away"]), ernd)
         # Defense/* telemetry scalars (obs/telemetry.py), shared emit path
         # so sync and async streams stay bit-identical
         obs_telemetry.emit_scalars(writer, vals, ernd)
@@ -630,255 +934,180 @@ def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
             mstate["t_steady"] = now
             mstate["r_steady"] = rounds_done_now
         else:
-            # steady window always ends at a snap boundary: a final partial
-            # segment (rounds % snap != 0) may fall back to the
+            # steady window always ends at a snap boundary: a final
+            # partial segment (rounds % snap != 0) may fall back to the
             # never-yet-compiled unchained round fn, and that compile must
             # not pollute the compile-free metric
             mstate["t_steady_end"] = now
             mstate["r_steady_end"] = rounds_done_now
         writer.flush()
+        mstate["cum_poison_acc"] = cum_poison_acc   # commit LAST (see top)
 
-    t_loop = time.perf_counter()
-    rounds_done = 0
-    rnd = start_round
-    first_unit = True
-    # ONE source of truth for chaining decisions: the loop consumes the
-    # same schedule the host-mode prefetcher produces against, so the two
-    # cannot desynchronize (code review r3)
-    units = dispatch_schedule(
-        start_round, cfg.rounds, cfg.snap, chain_n, cfg.diagnostics,
-        chained_fn is not None or host_chained_fn is not None)
-    sched_units = units   # consumed by get_unit's lazy prefetcher creation
-    # any exception must still tear down the prefetch worker —
-    # it pins device arrays and would leak per failed run
-    try:
-        for unit in units:
-            hb.update(phase="train", round=unit[-1])
-            if prof is not None and not first_unit:
-                # steady state: every hot-path program compiled during the
-                # first unit, so the window never captures XLA working
-                prof.maybe_start()
-            if len(unit) > 1:
-                # chained block: fixed length => one compilation per shape
-                with tracer.span("round/data_prep", round=unit[-1]):
-                    ids = jnp.arange(unit[0], unit[-1] + 1)
-                    payload = None if chained_fn is not None \
-                        else get_unit(unit)
-                with tracer.span("round/dispatch", round=unit[-1],
-                                 chain=len(unit)):
-                    if chained_fn is not None:
-                        params, stacked = chained_fn(params, base_key, ids)
-                    else:
-                        # host-sampled block: the prefetcher hands over the
-                        # whole [chain, m, ...] shard-stack payload at once
-                        _, imgs, lbls, szs = payload
-                        params, stacked = host_chained_fn(
-                            params, base_key, ids, imgs, lbls, szs)
-                rnd = unit[-1]
-                rounds_done += len(unit)
-                info = {"train_loss": stacked["train_loss"][-1]}
-                info.update({k: stacked[k][-1] for k in FAULT_INFO_KEYS
-                             if k in stacked})
-                info.update({k: stacked[k][-1] for k in stacked
-                             if k.startswith("tel_")})
-                want_diag, prev_params = False, None
-            else:
-                rnd = unit[0]
-                with tracer.span("round/data_prep", round=rnd):
-                    key = jax.random.fold_in(base_key, rnd)
-                    snap_round = rnd % cfg.snap == 0
-                    want_diag = cfg.diagnostics and snap_round
-                    prev_params = params if want_diag else None
-                if host_sampler is not None:
-                    # host_sampler opens its own data_prep/dispatch spans
-                    # (the gather is the interesting part there)
-                    params, info = host_sampler(params, key, rnd, want_diag)
-                else:
-                    with tracer.span("round/dispatch", round=rnd):
-                        params, info = (diag_round_fn if want_diag
-                                        else round_fn)(params, key)
-                rounds_done += 1
-            if prof is not None:
-                # accounts the unit toward the capture budget and polls
-                # the HBM watermarks; closes the window (blocking on
-                # params first) once the budget is reached
-                prof.after_unit(params, len(unit))
+    def drain_flush(self, timeout: Optional[float] = None) -> None:
+        """Surface queued metrics (and any drain-thread error) now."""
+        if self.drain is not None:
+            with self.tracer.span("drain/wait", round=self.rnd):
+                self.drain.flush(timeout=timeout)
 
-            if want_diag:
-                if "agent_norms" in info:
-                    for tag, v in norm_scalars(info["agent_norms"],
-                                               info["sampled"],
-                                               cfg.num_corrupt).items():
-                        writer.scalar(tag, v, rnd)
-                if "lr_flat" in info:
-                    from jax.flatten_util import ravel_pytree
-                    # Fisher at the pre-update params (aggregation.py:146-148)
-                    f_adv = ravel_pytree(fisher_fn(prev_params, *pval))[0]
-                    hon_labels = jnp.full_like(pval[1], cfg.base_class)
-                    f_hon = ravel_pytree(
-                        fisher_fn(prev_params, pval[0], hon_labels, pval[2]))[0]
-                    upd_flat = (ravel_pytree(params)[0]
-                                - ravel_pytree(prev_params)[0])
-                    # --diagnostics is the synchronous research mode by
-                    # design (the async drain is disabled); these fetches
-                    # happen at snap cadence only.
-                    # static: ok(host-sync)
-                    scalars, cum_net_mov = sign_agreement(
-                        np.asarray(info["lr_flat"]), np.asarray(upd_flat),
-                        np.asarray(f_adv), np.asarray(f_hon),
-                        cfg.top_frac, cfg.effective_server_lr, cum_net_mov)
-                    for tag, v in scalars.items():
-                        writer.scalar(tag, v, rnd)
+    def save_checkpoint(self, rnd: int, journal: bool = True,
+                        drain_timeout: Optional[float] = None) -> None:
+        """Checkpoint at an eval boundary. Every process calls save: orbax
+        runs cross-process barriers inside and writes replicated data from
+        the primary only — lead-gating it would deadlock a multi-host job.
+        The drain is flushed first (`drain_timeout` is the service
+        supervisor's wedge budget — TimeoutError classifies as wedged):
+        the saved cum_poison_acc must include every eval boundary up to
+        this round. With `journal`, the metrics byte offset is recorded
+        for crash-exact resume (utils/checkpoint.py round journal)."""
+        cfg = self.cfg
+        if not cfg.checkpoint_dir:
+            return
+        self.drain_flush(timeout=drain_timeout)
+        self.hb.update(phase="checkpoint", round=rnd)
+        with self.tracer.span("ckpt/save", round=rnd):
+            # -1 = auto: keep everything in the one-shot trainer (historic
+            # behavior); serve() replaces it with its bounded default
+            keep = max(cfg.service_keep_ckpts, 0)
+            ckpt.save(cfg.checkpoint_dir, rnd, self.params, self.base_key,
+                      self.mstate["cum_poison_acc"], self.cum_net_mov,
+                      keep_last=keep)
+        if journal:
+            offset = getattr(self.writer, "offset", None)
+            if offset is not None:
+                ckpt.journal_record(cfg.checkpoint_dir, rnd, offset(),
+                                    keep_last=keep)
 
-            if rnd % cfg.snap == 0:
-                # HBM watermarks ride the heartbeat so the session stall
-                # detectors see memory pressure, not just phase ({} on
-                # backends without allocator stats)
-                hb.update(phase="eval", round=rnd,
-                          **obs_attribution.memory_watermarks())
-                # divergence aborts only under --debug_nan (sync mode);
-                # otherwise the finite check rides the drain and warns,
-                # and the run keeps recording its (NaN) metrics
-                vals = {"finite": all_finite_device(params)}
-                # eval dispatches on the (un-donated) params BEFORE the
-                # next dispatch unit runs: in async mode round r's eval
-                # executes overlapped with the round r+1 training block
-                with tracer.span("eval/val_dispatch", round=rnd):
-                    val_loss_d, val_acc_d, per_class_d = eval_val_fn(params,
-                                                                     *val)
-                with tracer.span("eval/poison_dispatch", round=rnd):
-                    poison_loss_d, poison_acc_d, _ = eval_pval_fn(params,
-                                                                  *pval)
-                vals.update(val_loss=val_loss_d, val_acc=val_acc_d,
-                            base_acc=per_class_d[cfg.base_class],
-                            poison_loss=poison_loss_d,
-                            poison_acc=poison_acc_d,
-                            train_loss=info["train_loss"])
-                if "fault_voters" in info:
-                    vals.update({k: info[k] for k in FAULT_INFO_KEYS})
-                # in-jit defense telemetry rides the same (async) fetch
-                vals.update({k: info[k] for k in info
-                             if k.startswith("tel_")})
-                if drain is not None:
-                    elapsed = time.perf_counter() - t_loop
-                    drain.submit(emit_eval, vals, rnd, rounds_done, elapsed)
-                else:
-                    with tracer.span("metrics/host_sync", round=rnd):
-                        # this IS the --sync_metrics fallback path; async
-                        # mode routes the same fetch through the
-                        # MetricsDrain instead.
-                        # static: ok(host-sync)
-                        vals = jax.device_get(vals)  # THE per-round sync
-                    elapsed = time.perf_counter() - t_loop
-                    emit_eval(vals, rnd, rounds_done, elapsed)
-                # every process calls save: orbax runs cross-process barriers
-                # inside and writes replicated data from the primary only —
-                # lead-gating it would deadlock a multi-host job. The drain
-                # is flushed first: the saved cum_poison_acc must include
-                # every eval boundary up to this round.
-                if cfg.checkpoint_dir:
-                    if drain is not None:
-                        with tracer.span("drain/wait", round=rnd):
-                            drain.flush()
-                    hb.update(phase="checkpoint", round=rnd)
-                    with tracer.span("ckpt/save", round=rnd):
-                        ckpt.save(cfg.checkpoint_dir, rnd, params, base_key,
-                                  mstate["cum_poison_acc"], cum_net_mov)
-            if first_unit:
-                # every hot-path program has now traced+compiled (or
-                # loaded); from here a silent heartbeat means a stall,
-                # not XLA working
-                first_unit = False
-                hb.update(compile_in_flight=False, force=True)
-            if drain is None:
-                writer.flush()
-        # surface any drain-thread error while the run's state is intact
-        # (the finally below closes without raising, to not mask a loop
-        # exception with a secondary metrics error)
-        if drain is not None:
-            hb.update(phase="drain", force=True)
-            with tracer.span("drain/wait"):
-                drain.flush()
-    finally:
-        if drain is not None:
-            drain.close(raise_errors=False)
-        if prefetcher is not None:
-            prefetcher.close()
-        if prof is not None:
+    def post_unit(self) -> None:
+        """End-of-unit bookkeeping: flip the compile flag after the first
+        unit (from here a silent heartbeat means a stall, not XLA working)
+        and flush the writer in sync mode."""
+        if self.first_unit:
+            self.first_unit = False
+            self.hb.update(compile_in_flight=False, force=True)
+        if self.drain is None:
+            self.writer.flush()
+
+    # ------------------------------------------------------------- teardown
+
+    def close(self) -> None:
+        """Release threads/devices — the `finally` step. Any exception must
+        still tear down the prefetch worker (it pins device arrays and
+        would leak per failed run); the drain closes without raising, to
+        not mask a loop exception with a secondary metrics error."""
+        if self.drain is not None:
+            self.drain.close(raise_errors=False)
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        if self.prof is not None:
             # a run shorter than the budget still flushes its window
-            prof.close(params)
+            self.prof.close(self.params)
 
-    if cfg.profile_dir and lead and prof is None:
-        jax.profiler.stop_trace()
+    def finalize(self) -> Dict:
+        """Post-loop summary: throughput, attribution, memory watermarks,
+        span aggregates; closes the writer and the heartbeat."""
+        cfg, writer, mstate = self.cfg, self.writer, self.mstate
+        if self._whole_run_trace:
+            jax.profiler.stop_trace()
+            self._whole_run_trace = False
+        elapsed = time.perf_counter() - self.t_loop
+        summary = dict(mstate["summary"])
+        summary.setdefault("round", cfg.rounds)
+        summary["rounds_per_sec"] = self.rounds_done / max(elapsed, 1e-9)
+        if (mstate["t_steady"] is not None
+                and mstate["t_steady_end"] is not None
+                and mstate["r_steady_end"] > mstate["r_steady"]):
+            summary["steady_rounds_per_sec"] = (
+                (mstate["r_steady_end"] - mstate["r_steady"])
+                / max(mstate["t_steady_end"] - mstate["t_steady"], 1e-9))
+        summary["params"] = param_count(self.params)
+        print("Training has finished!")
+        print(f"[throughput] {summary['rounds_per_sec']:.3f} rounds/sec "
+              f"({self.rounds_done} rounds in {elapsed:.1f}s)"
+              + (f"; steady-state "
+                 f"{summary['steady_rounds_per_sec']:.3f} r/s"
+                 if "steady_rounds_per_sec" in summary else ""))
+        # device-time attribution (obs/attribution.py): the sampled capture
+        # window parses into Device/* rows + the summary; HBM watermarks
+        # (the per-captured-unit maxima, plus a final poll) land as
+        # Memory/* rows and heartbeat fields. All of it is absent when
+        # --profile_rounds=0 and the backend exposes no memory_stats — the
+        # off path emits nothing.
+        mem = obs_attribution.memory_watermarks()
+        if self.prof is not None:
+            for key, val in self.prof.mem.items():
+                mem[key] = max(mem.get(key, 0), val)
+            attr = self.prof.result()
+            if attr is not None:
+                for tag, v in obs_attribution.scalar_rows(attr):
+                    writer.scalar(tag, v, self.rnd)
+                summary["attribution"] = attr
+                if attr.get("device_present"):
+                    pr = attr.get("per_round", {})
+                    print(f"[profile] device time/round: "
+                          f"{pr.get('compute_ms', 0.0):.1f} ms compute + "
+                          f"{pr.get('collective_ms', 0.0):.1f} ms "
+                          f"collective + {pr.get('gap_ms', 0.0):.1f} ms "
+                          f"gap ({100 * attr['collective_frac']:.1f}% "
+                          f"collective)")
+                else:
+                    print(f"[profile] {attr.get('note', 'no device track')}")
+        if mem:
+            # memory_rows values are host ints from device.memory_stats()
+            for tag, val in obs_attribution.memory_rows(mem):
+                writer.scalar(tag, val, self.rnd)
+            summary["memory"] = mem
+            self.hb.update(**mem)
+        # per-span aggregates -> metrics.jsonl (Spans/*) and the summary;
+        # the full event stream -> trace.json in the run dir
+        # (Perfetto-loadable)
+        if self.tracer.enabled:
+            for tag, v in self.tracer.scalar_rows():
+                writer.scalar(tag, v, self.rnd)
+            summary["spans"] = self.tracer.aggregates()
+            run_dir = getattr(writer, "dir", None)
+            if run_dir:
+                trace_path = self.tracer.write_trace(
+                    os.path.join(run_dir, "trace.json"))
+                if trace_path:
+                    summary["trace_path"] = trace_path
+                    print(f"[spans] {trace_path} "
+                          f"(load in https://ui.perfetto.dev)")
+        writer.close()
+        self.hb.close("done")
+        return summary
 
-    elapsed = time.perf_counter() - t_loop
-    summary = dict(mstate["summary"])
-    summary.setdefault("round", cfg.rounds)
-    summary["rounds_per_sec"] = rounds_done / max(elapsed, 1e-9)
-    if (mstate["t_steady"] is not None and mstate["t_steady_end"] is not None
-            and mstate["r_steady_end"] > mstate["r_steady"]):
-        summary["steady_rounds_per_sec"] = (
-            (mstate["r_steady_end"] - mstate["r_steady"])
-            / max(mstate["t_steady_end"] - mstate["t_steady"], 1e-9))
-    summary["params"] = param_count(params)
-    print("Training has finished!")
-    print(f"[throughput] {summary['rounds_per_sec']:.3f} rounds/sec "
-          f"({rounds_done} rounds in {elapsed:.1f}s)"
-          + (f"; steady-state {summary['steady_rounds_per_sec']:.3f} r/s"
-             if "steady_rounds_per_sec" in summary else ""))
-    # device-time attribution (obs/attribution.py): the sampled capture
-    # window parses into Device/* rows + the summary; HBM watermarks (the
-    # per-captured-unit maxima, plus a final poll) land as Memory/* rows
-    # and heartbeat fields. All of it is absent when --profile_rounds=0
-    # and the backend exposes no memory_stats — the off path emits nothing.
-    mem = obs_attribution.memory_watermarks()
-    if prof is not None:
-        for key, val in prof.mem.items():
-            mem[key] = max(mem.get(key, 0), val)
-        attr = prof.result()
-        if attr is not None:
-            for tag, v in obs_attribution.scalar_rows(attr):
-                writer.scalar(tag, v, rnd)
-            summary["attribution"] = attr
-            if attr.get("device_present"):
-                pr = attr.get("per_round", {})
-                print(f"[profile] device time/round: "
-                      f"{pr.get('compute_ms', 0.0):.1f} ms compute + "
-                      f"{pr.get('collective_ms', 0.0):.1f} ms collective "
-                      f"+ {pr.get('gap_ms', 0.0):.1f} ms gap "
-                      f"({100 * attr['collective_frac']:.1f}% collective)")
-            else:
-                print(f"[profile] {attr.get('note', 'no device track')}")
-    if mem:
-        # memory_rows values are host ints from device.memory_stats()
-        for tag, val in obs_attribution.memory_rows(mem):
-            writer.scalar(tag, val, rnd)
-        summary["memory"] = mem
-        hb.update(**mem)
-    # per-span aggregates -> metrics.jsonl (Spans/*) and the summary; the
-    # full event stream -> trace.json in the run dir (Perfetto-loadable)
-    if tracer.enabled:
-        for tag, v in tracer.scalar_rows():
-            writer.scalar(tag, v, rnd)
-        summary["spans"] = tracer.aggregates()
-        run_dir = getattr(writer, "dir", None)
-        if run_dir:
-            trace_path = tracer.write_trace(
-                os.path.join(run_dir, "trace.json"))
-            if trace_path:
-                summary["trace_path"] = trace_path
-                print(f"[spans] {trace_path} "
-                      f"(load in https://ui.perfetto.dev)")
-    writer.close()
-    hb.close("done")
-    return summary
+
+def run(cfg: Config, writer: Optional[MetricsWriter] = None) -> Dict:
+    """The one-shot trainer: build the engine, iterate its schedule, emit
+    the summary — exactly the historical loop, now over RoundEngine
+    steps."""
+    eng = RoundEngine(cfg, writer=writer)
+    try:
+        for unit in eng.schedule():
+            eng.dispatch(unit)
+            if eng.rnd % cfg.snap == 0:
+                eng.eval_boundary(eng.rnd)
+                eng.save_checkpoint(eng.rnd)
+            eng.post_unit()
+        # surface any drain-thread error while the run's state is intact
+        # (close() below closes without raising, to not mask a loop
+        # exception with a secondary metrics error)
+        if eng.drain is not None:
+            eng.hb.update(phase="drain", force=True)
+            with eng.tracer.span("drain/wait"):
+                eng.drain.flush()
+    finally:
+        eng.close()
+    return eng.finalize()
 
 
 def main(argv=None):
     cfg = args_parser(argv)
     if cfg.platform:
-        # must land before any backend use; this environment's sitecustomize
-        # pins a platform at interpreter start, so env vars alone are too late
+        # must land before any backend use; this environment's
+        # sitecustomize pins a platform at interpreter start, so env vars
+        # alone are too late
         jax.config.update("jax_platforms", cfg.platform)
     if cfg.num_processes > 1 or cfg.coordinator:
         from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (

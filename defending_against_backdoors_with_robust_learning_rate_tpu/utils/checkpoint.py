@@ -1,18 +1,50 @@
-"""Orbax checkpoint / resume.
+"""Orbax checkpoint / resume, hardened for the continuous-service driver.
 
 The reference has NO checkpointing (SURVEY.md section 5.4: a killed 500-round
-run restarts from scratch). The build adds it: (global params, round, PRNG
+run restarts from scratch). The build adds it — (global params, round, PRNG
 key, cumulative poison accuracy) saved every `snap` rounds, restored with
-``--resume``."""
+``--resume`` — and the service subsystem (service/driver.py) hardens it to
+crash-exact recovery:
+
+- **digest sidecars**: every completed checkpoint directory gets a
+  ``round_NNNNNN.digest`` file (sha256 over the directory's file bytes,
+  written atomically AFTER orbax finishes, so sidecar presence implies a
+  complete checkpoint). ``restore`` verifies the digest before trusting a
+  checkpoint and **falls back to the newest digest-valid one** instead of
+  crashing on a truncated/corrupt latest file; a checkpoint written before
+  digests existed restores on the legacy trust-the-directory path.
+- **keep-K pruning**: ``save(keep_last=K)`` reaps the oldest checkpoints
+  (and their sidecars) beyond K — the service driver checkpoints forever
+  and must not grow the directory without bound.
+- **round journal**: a small atomically-rewritten ``journal.json`` mapping
+  each checkpointed round to the byte offset of ``metrics.jsonl`` at save
+  time. On crash recovery the driver truncates the metrics stream back to
+  the journaled offset of whichever checkpoint proved digest-valid, then
+  replays — so an interrupted-and-resumed run reproduces the uninterrupted
+  run's metrics file byte-for-byte (modulo wall-clock rows).
+
+A ``kill -9`` at ANY point leaves one of: an orbax tmp dir (ignored by
+``latest_round``), a complete dir without a sidecar (restored on the legacy
+path), a complete dir + sidecar without a journal entry (the journal still
+points at the previous checkpoint; the replay is deterministic), or a fully
+recorded boundary. Every case resumes to bit-identical metrics rows —
+tests/test_service.py drives each one via service/chaos.py.
+"""
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
-from typing import Any, Optional, Tuple
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+JOURNAL_NAME = "journal.json"
 
 
 def _ckptr():
@@ -20,9 +52,60 @@ def _ckptr():
     return ocp.StandardCheckpointer()
 
 
+def _round_path(ckpt_dir: str, rnd: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), f"round_{rnd:06d}")
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+# ----------------------------------------------------------------- digests ---
+
+def dir_digest(path: str) -> str:
+    """sha256 over a checkpoint directory's (sorted relative path, file
+    bytes) — file-level, so corruption is detectable WITHOUT attempting an
+    orbax restore (a restore failure can then be trusted to mean a
+    structural mismatch, which must stay loud, not a disk problem)."""
+    h = hashlib.sha256()
+    for base, dirs, files in sorted(os.walk(path)):
+        dirs.sort()
+        for name in sorted(files):
+            fp = os.path.join(base, name)
+            h.update(os.path.relpath(fp, path).encode())
+            with open(fp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+def digest_valid(ckpt_dir: str, rnd: int) -> Optional[bool]:
+    """True/False = sidecar present and matching/violated; None = no
+    sidecar (a pre-digest legacy checkpoint — unknown, trusted)."""
+    path = _round_path(ckpt_dir, rnd)
+    try:
+        with open(path + ".digest", encoding="utf-8") as f:
+            want = f.read().strip()
+    except OSError:
+        return None
+    if not os.path.isdir(path):
+        return False
+    try:
+        return dir_digest(path) == want
+    except OSError:
+        return False
+
+
+# ------------------------------------------------------------- save/restore ---
+
 def save(ckpt_dir: str, rnd: int, params, key, cum_poison_acc: float,
-         cum_net_mov: float = 0.0) -> None:
-    path = os.path.join(os.path.abspath(ckpt_dir), f"round_{rnd:06d}")
+         cum_net_mov: float = 0.0, keep_last: int = 0) -> str:
+    """Write the round checkpoint + digest sidecar; prune to ``keep_last``
+    newest checkpoints when > 0. Returns the checkpoint path."""
+    path = _round_path(ckpt_dir, rnd)
     state = {
         "params": jax.device_get(params),
         "round": np.asarray(rnd, np.int64),
@@ -33,25 +116,42 @@ def save(ckpt_dir: str, rnd: int, params, key, cum_poison_acc: float,
     ckptr = _ckptr()
     ckptr.save(path, state, force=True)
     ckptr.wait_until_finished()
+    # sidecar LAST (atomic): its presence implies the directory is complete
+    atomic_write_text(path + ".digest", dir_digest(path) + "\n")
+    if keep_last > 0:
+        prune(ckpt_dir, keep_last)
+    return path
+
+
+def saved_rounds(ckpt_dir: str) -> List[int]:
+    """Complete checkpoint rounds on disk, ascending (orbax tmp dirs from
+    a kill mid-save are excluded by the name filter)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(m.group(1)) for d in os.listdir(ckpt_dir)
+                  if (m := re.fullmatch(r"round_(\d+)", d)))
 
 
 def latest_round(ckpt_dir: str) -> Optional[int]:
-    if not os.path.isdir(ckpt_dir):
-        return None
-    # only complete checkpoints: a kill mid-save leaves
-    # round_NNNNNN.orbax-checkpoint-tmp-* directories behind
-    rounds = [int(m.group(1)) for d in os.listdir(ckpt_dir)
-              if (m := re.fullmatch(r"round_(\d+)", d))]
-    return max(rounds) if rounds else None
+    rounds = saved_rounds(ckpt_dir)
+    return rounds[-1] if rounds else None
 
 
-def restore(ckpt_dir: str, params_like
-            ) -> Optional[Tuple[int, Any, Any, float, float]]:
-    """Returns (round, params, key, cum_poison_acc, cum_net_mov) or None."""
-    rnd = latest_round(ckpt_dir)
-    if rnd is None:
-        return None
-    path = os.path.join(os.path.abspath(ckpt_dir), f"round_{rnd:06d}")
+def prune(ckpt_dir: str, keep_last: int) -> None:
+    """Reap the oldest checkpoints (and sidecars) beyond ``keep_last``."""
+    for rnd in saved_rounds(ckpt_dir)[:-keep_last]:
+        path = _round_path(ckpt_dir, rnd)
+        shutil.rmtree(path, ignore_errors=True)
+        try:
+            os.remove(path + ".digest")
+        except OSError:
+            pass
+
+
+def _restore_state(path: str, params_like) -> Tuple[Dict[str, Any], Any]:
+    """One checkpoint's state via orbax (with the legacy no-cum_net_mov
+    fallback). Raises on structural mismatch — the caller has already
+    ruled out disk corruption via the digest."""
     key_shape = jax.random.key_data(jax.random.PRNGKey(0)).shape
     target = {
         "params": jax.device_get(params_like),
@@ -86,6 +186,118 @@ def restore(ckpt_dir: str, params_like
             f"checkpoint {path} stores PRNG key data of shape "
             f"{key_data.shape} but the active --rng_impl expects {key_shape};"
             f" resume under the rng_impl that wrote the checkpoint")
-    key = jax.random.wrap_key_data(key_data)
-    return (int(state["round"]), state["params"], key,
-            float(state["cum_poison_acc"]), float(state["cum_net_mov"]))
+    return state, jax.random.wrap_key_data(key_data)
+
+
+def newest_valid_round(ckpt_dir: str) -> Optional[int]:
+    """The round ``restore`` would resume from: newest checkpoint whose
+    digest is not provably violated (legacy no-sidecar checkpoints are
+    trusted)."""
+    for rnd in reversed(saved_rounds(ckpt_dir)):
+        if digest_valid(ckpt_dir, rnd) is not False:
+            return rnd
+    return None
+
+
+def newest_resumable_round(ckpt_dir: str) -> Optional[int]:
+    """The round crash-exact resume restores: newest digest-valid round
+    that ALSO has a journal entry. A kill between ``save`` and
+    ``journal_record`` leaves a newer digest-valid-but-unjournaled
+    checkpoint; the journal still points at the previous one, and resuming
+    THERE keeps the metrics splice exact — the orphan checkpoint is
+    overwritten when its round is re-reached. A dir with checkpoints but
+    no journal at all (pre-journal writer) falls back to
+    ``newest_valid_round`` with no exactness claim. The service driver
+    uses this BEFORE building the engine to truncate the metrics stream to
+    the returned round's journaled offset."""
+    journaled = {e["round"] for e in journal_read(ckpt_dir)}
+    if not journaled:
+        return newest_valid_round(ckpt_dir)
+    for rnd in reversed(saved_rounds(ckpt_dir)):
+        if rnd in journaled and digest_valid(ckpt_dir, rnd) is not False:
+            return rnd
+    return None
+
+
+def restore(ckpt_dir: str, params_like, upto: Optional[int] = None,
+            upto_validated: bool = False
+            ) -> Optional[Tuple[int, Any, Any, float, float]]:
+    """Returns (round, params, key, cum_poison_acc, cum_net_mov) from the
+    newest digest-valid checkpoint, or None when no usable checkpoint
+    exists.
+
+    Fallback policy: a checkpoint whose digest sidecar MISMATCHES its
+    directory (truncated/corrupted on disk) is skipped with a warning and
+    the next-newest is tried — a crash must cost at most one snap
+    interval, never the run. A checkpoint whose digest is VALID but whose
+    restore raises (structural mismatch, cross-rng_impl resume) re-raises:
+    that is an operator error, and silently resuming something older would
+    hide it.
+
+    ``upto`` pins the newest round considered (the service driver passes
+    its journal-agreed resume round so restore cannot pick a newer
+    unjournaled orphan; ``upto=0`` restores nothing — fresh start).
+    ``upto_validated`` skips re-hashing round ``upto``'s directory when the
+    caller just digest-validated it (newest_resumable_round reads every
+    byte; doing it twice doubles recovery I/O for large models)."""
+    rounds = saved_rounds(ckpt_dir)
+    if upto is not None:
+        rounds = [r for r in rounds if r <= upto]
+    for rnd in reversed(rounds):
+        valid = (True if upto_validated and rnd == upto
+                 else digest_valid(ckpt_dir, rnd))
+        if valid is False:
+            print(f"[ckpt] round_{rnd:06d}: digest mismatch "
+                  f"(truncated/corrupt checkpoint) — falling back to the "
+                  f"previous one")
+            continue
+        state, key = _restore_state(_round_path(ckpt_dir, rnd), params_like)
+        return (int(state["round"]), state["params"], key,
+                float(state["cum_poison_acc"]), float(state["cum_net_mov"]))
+    return None
+
+
+# ------------------------------------------------------------ round journal ---
+
+def journal_path(ckpt_dir: str) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), JOURNAL_NAME)
+
+
+def journal_read(ckpt_dir: str) -> List[Dict[str, Any]]:
+    """The journal's entries (ascending rounds); [] when absent or
+    unreadable (a torn write is impossible — writes go through
+    tmp + os.replace — but a hand-edited file must not take down the
+    driver)."""
+    try:
+        with open(journal_path(ckpt_dir), encoding="utf-8") as f:
+            data = json.load(f)
+        return sorted(data.get("entries", []), key=lambda e: e["round"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return []
+
+
+def journal_record(ckpt_dir: str, rnd: int, metrics_offset: int,
+                   keep_last: int = 0, **extra) -> None:
+    """Append/replace the entry for ``rnd`` (atomic rewrite). Entries for
+    rounds whose checkpoints were pruned are dropped alongside, bounded by
+    ``keep_last`` like the checkpoints themselves."""
+    entries = [e for e in journal_read(ckpt_dir) if e["round"] != rnd]
+    entries.append({"round": int(rnd),
+                    "metrics_offset": int(metrics_offset),
+                    "wall_time": time.time(), **extra})
+    entries.sort(key=lambda e: e["round"])
+    if keep_last > 0:
+        entries = entries[-keep_last:]
+    os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+    atomic_write_text(journal_path(ckpt_dir),
+                       json.dumps({"version": 1, "entries": entries},
+                                  indent=1) + "\n")
+
+
+def journal_offset_for(ckpt_dir: str, rnd: int) -> int:
+    """metrics.jsonl byte offset journaled for checkpoint round ``rnd``;
+    0 when unjournaled (fresh start — truncate everything and replay)."""
+    for e in journal_read(ckpt_dir):
+        if e["round"] == rnd:
+            return int(e["metrics_offset"])
+    return 0

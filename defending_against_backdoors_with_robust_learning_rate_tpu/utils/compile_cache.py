@@ -83,6 +83,12 @@ EXCLUDED_FIELDS = frozenset({
     "rng_impl", "mesh", "host_sampled",
     # sampled profiler window (obs/attribution.py): observation only
     "profile_rounds",
+    # continuous-service driver knobs (service/): retry policy, streaming
+    # budget, checkpoint retention and chaos injection are all host-side —
+    # none shapes a traced program (churn_* fields by contrast DO and are
+    # fingerprinted)
+    "service_rounds", "service_retries", "service_backoff_s",
+    "service_deadline_s", "service_keep_ckpts", "chaos",
 })
 
 # families built from cfg.replace(diagnostics=False) in the driver; their
@@ -414,14 +420,19 @@ def plan_programs(cfg, model, norm, fed,
                 make_chained_round_fn_host(plain, model, norm),
                 (params_aval, key_aval, ids_aval) + block_avals))
     else:
+        # churn round programs take the round index as a traced int32
+        # scalar (service/churn.py: the lifecycle phase is a function of
+        # time, not of the round key)
+        lead = ((jax.ShapeDtypeStruct((), jnp.int32),)
+                if cfg.churn_enabled else ())
         specs.append(ProgramSpec(
             "round", make_round_fn(plain, model, norm, *data_avals).jitted,
-            (params_aval, key_aval) + data_avals))
+            (params_aval, key_aval) + lead + data_avals))
         if cfg.diagnostics:
             specs.append(ProgramSpec(
                 "round_diag",
                 make_round_fn(cfg, model, norm, *data_avals).jitted,
-                (params_aval, key_aval) + data_avals))
+                (params_aval, key_aval) + lead + data_avals))
         if chain_n > 1:
             specs.append(ProgramSpec(
                 "chained",
@@ -478,17 +489,19 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
             make_sharded_round_fn_host(plain, model, norm, mesh),
             (params_aval, key_aval) + shard_avals + flags))
         return specs
+    lead = ((jax.ShapeDtypeStruct((), jnp.int32),)
+            if cfg.churn_enabled else ())
     specs.append(ProgramSpec(
         "round_sharded",
         make_sharded_round_fn(plain, model, norm, mesh,
                               *data_avals).jitted,
-        (params_aval, key_aval) + data_avals))
+        (params_aval, key_aval) + lead + data_avals))
     if cfg.diagnostics:
         specs.append(ProgramSpec(
             "round_sharded_diag",
             make_sharded_round_fn(cfg, model, norm, mesh,
                                   *data_avals).jitted,
-            (params_aval, key_aval) + data_avals))
+            (params_aval, key_aval) + lead + data_avals))
     if chain_n > 1:
         ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
         specs.append(ProgramSpec(

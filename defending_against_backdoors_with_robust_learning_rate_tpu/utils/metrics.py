@@ -39,11 +39,17 @@ def run_name(cfg) -> str:
                   f"s{cfg.straggler_rate}c{cfg.corrupt_rate}"
                   f"-thrm:{cfg.rlr_threshold_mode}"
                   + ("-spare" if cfg.faults_spare_corrupt else ""))
+    churn = ""
+    if cfg.churn_enabled:
+        # same collision rule as the fault knobs: two cells differing only
+        # in the churn process must not share a run dir
+        churn = (f"-chrn:a{cfg.churn_available}p{cfg.churn_period}"
+                 f"s{cfg.churn_seed}")
     return (f"clip_val:{cfg.clip}"
             f"-noise_std:{cfg.noise}-aggr:{cfg.aggr}"
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
-            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}{faults}")
+            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}{faults}{churn}")
 
 
 class NullWriter:
@@ -68,9 +74,19 @@ class MetricsDrain:
     callbacks in strict FIFO order, so the metrics stream is bit-identical
     to the synchronous path (tests/test_async_metrics.py pins this).
 
-    Error policy: a callback exception stops the drain, is re-raised at the
-    next flush()/close() on the submitting thread, and later submissions
-    are dropped — metrics can lag, never corrupt silently."""
+    Error policy: a callback exception stops the drain and is re-raised on
+    the submitting thread at the NEXT submit() — i.e. at the next dispatch
+    unit, not only at the next (possibly much later) flush()/close() —
+    whichever of submit/flush/close comes first. After the error is
+    delivered once, later submissions are silently dropped — metrics can
+    lag, never corrupt silently.
+
+    ``flush(timeout=...)`` raises TimeoutError when the drain makes no
+    progress within the budget — the service supervisor's wedge signal
+    (service/supervisor.py classifies it and degrades to sync metrics).
+    ``close()`` interrupted by KeyboardInterrupt still flushes cleanly:
+    the worker is told to stop, drains everything already queued, and the
+    interrupt then propagates — a ^C never loses recorded rows."""
 
     def __init__(self, tracer=None):
         self._items = collections.deque()
@@ -85,10 +101,32 @@ class MetricsDrain:
         # (the host sync this pipeline hides) on the drain thread's track
         self._tracer = tracer
 
+    @property
+    def dead(self) -> bool:
+        """True once the drain thread has exited on an error: callbacks no
+        longer execute and submits are dropped. The service driver checks
+        this after every supervised eval unit — a dead drain means the
+        boundary's rows were lost, so it degrades to synchronous metrics
+        and replays the boundary inline instead of serving on with a
+        silently dark pipeline."""
+        with self._lock:
+            return self._dead
+
+    def _raise_pending_locked(self) -> None:
+        """Deliver the drain thread's error exactly once (caller holds the
+        lock)."""
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
     def submit(self, fn, device_vals, *host_args) -> None:
         """Queue fn(fetched_device_vals, *host_args) for the drain thread.
-        `device_vals` may be any pytree of jax arrays (or host scalars)."""
+        `device_vals` may be any pytree of jax arrays (or host scalars).
+        A pending drain-thread error is re-raised HERE — the main loop
+        learns about a failed metrics callback at its next dispatch, not
+        only at the next checkpoint flush."""
         with self._cond:
+            self._raise_pending_locked()
             if self._dead:
                 return
             if self._thread is None:
@@ -132,39 +170,66 @@ class MetricsDrain:
                 self._pending -= len(batch)
                 self._cond.notify_all()
 
-    def flush(self) -> None:
+    def flush(self, timeout: Optional[float] = None) -> None:
         """Block until every queued callback has run; re-raise the first
-        drain-thread error on this (the submitting) thread."""
+        drain-thread error on this (the submitting) thread. With a
+        ``timeout`` (seconds), raise TimeoutError when callbacks are still
+        pending past it — the wedged-drain signal the service supervisor
+        consumes."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self._cond:
             while self._pending > 0 and self._error is None:
-                self._cond.wait()
-            if self._error is not None:
-                err, self._error = self._error, None
-                raise err
+                if deadline is None:
+                    self._cond.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"metrics drain stalled: {self._pending} "
+                        f"callback(s) still pending after {timeout:.1f}s")
+                self._cond.wait(remaining)
+            self._raise_pending_locked()
 
-    def close(self, raise_errors: bool = True) -> None:
-        try:
-            self.flush()
-        except BaseException:
-            if raise_errors:
-                raise
+    def _stop_and_join(self, join_timeout: float = 30.0) -> None:
         with self._cond:
             self._stop = True
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=30.0)
+            self._thread.join(timeout=join_timeout)
             self._thread = None
+
+    def close(self, raise_errors: bool = True,
+              timeout: Optional[float] = None) -> None:
+        try:
+            self.flush(timeout=timeout)
+        except KeyboardInterrupt:
+            # ^C mid-flush: flush cleanly anyway. The worker's stop
+            # protocol drains everything already queued before exiting
+            # (_loop returns only when stop is set AND the queue is
+            # empty), so recorded rows still land; then the interrupt
+            # propagates — regardless of raise_errors, a user interrupt
+            # is never swallowed.
+            self._stop_and_join(join_timeout=5.0)
+            raise
+        except BaseException:
+            self._stop_and_join()
+            if raise_errors:
+                raise
+            return
+        self._stop_and_join()
 
 
 class MetricsWriter:
     """JSONL always; TensorBoard when available and enabled."""
 
     def __init__(self, log_dir: str, name: Optional[str] = None,
-                 tensorboard: bool = True):
+                 tensorboard: bool = True, boundary: bool = True):
         os.makedirs(log_dir, exist_ok=True)
         self.dir = os.path.join(log_dir, name) if name else log_dir
         os.makedirs(self.dir, exist_ok=True)
-        self._jsonl = open(os.path.join(self.dir, "metrics.jsonl"), "a")
+        self.jsonl_path = os.path.join(self.dir, "metrics.jsonl")
+        self._jsonl = open(self.jsonl_path, "a")
         self._tb = None
         if tensorboard:
             try:
@@ -174,9 +239,21 @@ class MetricsWriter:
                 self._tb = None
         # deterministic run_name means reruns of one config share this file
         # (resume appends by design); a boundary record lets readers split
-        # the stream into runs instead of seeing duplicate (tag, step) rows
-        self._jsonl.write(json.dumps(
-            {"tag": "_run/start", "value": time.time(), "step": -1}) + "\n")
+        # the stream into runs instead of seeing duplicate (tag, step) rows.
+        # `boundary=False` is the crash-exact resume path (service/driver):
+        # the stream was truncated to a journaled offset and the continued
+        # rows must splice in with NO extra record, so the recovered file
+        # is byte-identical to an uninterrupted run's.
+        if boundary:
+            self._jsonl.write(json.dumps(
+                {"tag": "_run/start", "value": time.time(), "step": -1})
+                + "\n")
+
+    def offset(self) -> int:
+        """Current byte offset of metrics.jsonl (flushed) — what the round
+        journal records at checkpoint boundaries (utils/checkpoint.py)."""
+        self._jsonl.flush()
+        return self._jsonl.tell()
 
     def scalar(self, tag: str, value, step: int) -> None:
         self._jsonl.write(json.dumps(

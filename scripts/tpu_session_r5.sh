@@ -24,6 +24,32 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=logs/tpu_session_r5.log
 mkdir -p logs
+stamp() { date "+%F %T"; }
+say() { echo "[$(stamp)] $*" | tee -a "$LOG"; }
+
+# device-reachability pre-flight (ISSUE 6 satellite, ROADMAP note): probe
+# the backend BEFORE taking the session lock. BENCH_r04/r05 both burned
+# their one lock on a wedged tunnel that silently fell back to XLA:CPU —
+# jax.devices() "succeeded", the session ran, and every measurement was a
+# CPU number. The probe therefore asserts the devices are ACTUALLY tpu:
+# a CPU fallback is a failed probe, and a failed probe must not consume
+# the lock (the watcher can re-fire when the tunnel answers for real).
+probe_tpu() {
+    timeout "${1:-60}" python - <<'PY'
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform == "tpu", f"CPU fallback, not a TPU: {ds}"
+print(ds)
+PY
+}
+
+say "pre-flight: probing TPU backend before taking the lock (60s budget)..."
+if ! probe_tpu 60 >>"$LOG" 2>&1; then
+    say "pre-flight failed (wedged tunnel or CPU fallback) — lock NOT taken; re-run later"
+    exit 1
+fi
+say "pre-flight OK: TPU devices answer"
+
 # single-instance lock: overlapping watchers may both see the tunnel come
 # alive in the same window; a second concurrent session would race the
 # first for the one chip and interleave results.json writes. mkdir is
@@ -34,8 +60,6 @@ if ! mkdir logs/tpu_session_r5.lock 2>/dev/null; then
     echo "[session] another tpu_session_r5 instance holds the lock — exiting"
     exit 0
 fi
-stamp() { date "+%F %T"; }
-say() { echo "[$(stamp)] $*" | tee -a "$LOG"; }
 
 SUCCESSES=0
 
@@ -104,9 +128,12 @@ run_bench() {
     return $rc
 }
 
-say "probing TPU backend (60s budget)..."
-if ! timeout 60 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1; then
-    say "TPU unreachable — aborting (wedged tunnel); re-run later"
+# re-verify under the lock: the tunnel can wedge in the window between
+# the pre-flight and the lock; same tpu-platform assertion (a session
+# that silently measures CPU is worse than no session)
+say "re-probing TPU backend under the lock (60s budget)..."
+if ! probe_tpu 60 >>"$LOG" 2>&1; then
+    say "TPU unreachable or CPU fallback — aborting (wedged tunnel); re-run later"
     rmdir logs/tpu_session_r5.lock   # a no-measurement abort must not
     exit 1                           # block the next (real) fire
 fi

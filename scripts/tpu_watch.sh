@@ -41,7 +41,15 @@ for i in $(seq 1 70); do
     sleep 520
     continue
   fi
-  if timeout 45 python -c "import jax; jax.devices()" >>"$W" 2>&1; then
+  # same device-reachability pre-flight as the session script: the probe
+  # must see ACTUAL tpu devices — a wedged tunnel silently falls back to
+  # XLA:CPU, jax.devices() "succeeds", and the launched session would burn
+  # its one lock measuring CPU numbers (the r4/r5 failure mode)
+  if timeout 45 python -c "
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform == 'tpu', f'CPU fallback, not a TPU: {ds}'
+print(ds)" >>"$W" 2>&1; then
     echo "[watcher] TPU alive at $(date); launching $SESSION" >>"$W"
     bash "$SESSION" >>"$W" 2>&1
     echo "[watcher] session rc=$? at $(date)" >>"$W"

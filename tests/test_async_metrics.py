@@ -66,9 +66,92 @@ def test_drain_error_propagates_to_flush_and_drops_later_items():
     except ValueError:
         raised = True
     assert raised
-    # the drain is dead: later submissions are dropped, close won't hang
+    # the drain is dead and the error was delivered: later submissions are
+    # silently dropped, close won't hang
     drain.submit(lambda v: None, jnp.float32(2.0))
     drain.close(raise_errors=False)
+
+
+def test_drain_error_propagates_at_next_submit():
+    """ISSUE-6 satellite: a background-thread exception reaches the main
+    loop at the NEXT dispatch's submit(), not only at the (much later)
+    checkpoint flush — and is delivered exactly once."""
+    import pytest
+
+    drain = MetricsDrain()
+
+    def boom(v):
+        raise ValueError("drain callback failed")
+
+    drain.submit(boom, jnp.float32(1.0))
+    # wait for the worker to hit the error without consuming it via flush
+    deadline = __import__("time").monotonic() + 10.0
+    while not drain._dead and __import__("time").monotonic() < deadline:
+        __import__("time").sleep(0.01)
+    with pytest.raises(ValueError, match="drain callback failed"):
+        drain.submit(lambda v: None, jnp.float32(2.0))
+    # delivered once: the following submit is a silent drop, flush is clean
+    drain.submit(lambda v: None, jnp.float32(3.0))
+    drain.flush()
+    drain.close(raise_errors=False)
+
+
+def test_drain_flush_timeout_signals_wedge():
+    """flush(timeout=...) raises TimeoutError while a callback is wedged —
+    the supervisor's drain-stall signal — and a later unbounded flush
+    completes once the wedge clears."""
+    import threading
+
+    import pytest
+
+    release = threading.Event()
+    ran = []
+
+    drain = MetricsDrain()
+    drain.submit(lambda v: (release.wait(10.0), ran.append(float(v))),
+                 jnp.float32(1.0))
+    with pytest.raises(TimeoutError, match="drain stalled"):
+        drain.flush(timeout=0.1)
+    release.set()
+    drain.flush()
+    assert ran == [1.0]
+    drain.close()
+
+
+def test_drain_keyboard_interrupt_flushes_cleanly():
+    """ISSUE-6 satellite: ^C during close()'s flush still lands every
+    queued row (the worker drains before exiting) and the interrupt
+    propagates. The interrupt is injected at the flush boundary (a real
+    signal's delivery timing is nondeterministic in a test)."""
+    import threading
+
+    import pytest
+
+    got = []
+    gate = threading.Event()
+    drain = MetricsDrain()
+    # the gate holds the worker so both rows are still queued/pending when
+    # close() hits the interrupt — the clean-flush claim is then non-vacuous
+    drain.submit(lambda v: (gate.wait(10.0), got.append(float(v))),
+                 jnp.float32(1.0))
+    drain.submit(lambda v: got.append(float(v)), jnp.float32(2.0))
+
+    orig_flush = drain.flush
+    state = {"interrupted": False}
+
+    def interrupted_flush(timeout=None):
+        if not state["interrupted"]:
+            state["interrupted"] = True
+            gate.set()
+            raise KeyboardInterrupt
+        orig_flush(timeout)
+
+    drain.flush = interrupted_flush
+    with pytest.raises(KeyboardInterrupt):
+        drain.close()
+    # flushed cleanly: every queued row ran before the worker stopped
+    assert got == [1.0, 2.0]
+    assert drain._thread is None
 
 
 def test_async_metrics_jsonl_identical_to_sync(tmp_path):

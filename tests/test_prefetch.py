@@ -32,6 +32,21 @@ def test_unit_tuple_keys():
         pf.close()
 
 
+def test_repeat_get_serves_cached_unit_for_retry():
+    """A supervised dispatch retry (service/supervisor.py) re-requests the
+    unit it just consumed; get() must hand back the same payload instead
+    of popping the next round and tripping the order check."""
+    pf = RoundPrefetcher(lambda r: r * 10, range(1, 4), depth=1)
+    try:
+        assert pf.get(1) == 10
+        assert pf.get(1) == 10   # retried dispatch, same round
+        assert pf.get(1) == 10   # repeated backoff attempts too
+        assert pf.get(2) == 20   # then the stream continues in order
+        assert pf.get(3) == 30
+    finally:
+        pf.close()
+
+
 def test_order_violation_raises():
     pf = RoundPrefetcher(lambda r: r, range(1, 4), depth=1)
     try:
