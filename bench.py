@@ -201,6 +201,25 @@ def main():
                          "telemetry (obs/telemetry.py) at this level and "
                          "report the overhead vs the off run (the "
                          "headline value stays the off number)")
+    ap.add_argument("--population_ladder", default="",
+                    help="comma-separated client populations (e.g. "
+                         "10000,100000,1000000): measure cohort-sampled "
+                         "(data/bank.py + data/cohort.py) rounds/sec at "
+                         "each rung with the flagship's cohort size, "
+                         "recording host-RSS/HBM watermarks per rung — the "
+                         "constant-memory evidence (ISSUE 7). Also runs "
+                         "the equal-cohort dense-vs-cohort A/B on the "
+                         "flagship config (label_shards bank: identical "
+                         "shards, the delta is pure cohort machinery)")
+    ap.add_argument("--ladder_partitioner",
+                    choices=("dirichlet", "pathological"),
+                    default="dirichlet",
+                    help="client-bank partitioner for the ladder rungs "
+                         "(label_shards cannot reach these populations)")
+    ap.add_argument("--ladder_spc", type=int, default=0,
+                    help="samples per client on the ladder rungs (0 = "
+                         "auto clamp; the SAME value lands on every rung, "
+                         "so rung rounds/sec are compute-comparable)")
     ap.add_argument("--status_file", default="logs/status.json",
                     help="heartbeat path (obs/heartbeat.py) the session "
                          "stall detector reads; empty disables")
@@ -539,6 +558,192 @@ def main():
         log(f"[bench] telemetry={args.telemetry} overhead: "
             f"{telemetry_out['overhead_pct']}%")
 
+    population_out = None
+    if args.population_ladder:
+        # population-axis measurement (ISSUE 7): the cohort-sampled path
+        # decouples population size from per-round cohort size. Two
+        # claims go on the record here: (1) equal-cohort overhead — the
+        # flagship config re-run through the cohort program over a
+        # label_shards bank (bitwise-identical shards, same [m, ...]
+        # shapes; the delta vs the dense headline is pure cohort
+        # machinery: in-program sampling + per-round gather/H2D, within
+        # 10% by acceptance); (2) the ladder — rounds/sec at each
+        # population rung with the SAME cohort size and samples/client
+        # (compute-comparable), with host peak RSS + HBM watermarks per
+        # rung. ru_maxrss is monotone, so an ascending ladder whose
+        # watermark stays flat IS the constant-memory proof.
+        import numpy as np
+
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            cohort as cohort_mod)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+            RoundPrefetcher)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+            get_cohort_data)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+            make_chained_cohort_round_fn, make_cohort_round_fn)
+
+        def measure_cohort(mcfg, label):
+            """Steady rounds/sec of mcfg's cohort-sampled program: the
+            driver's own prefetch pipeline (data/prefetch.py, depth 1)
+            overlaps the bank gather + H2D with the running block, so
+            the figure reflects the real round pipeline, not a
+            serialized gather."""
+            hb.update(phase=f"population{label}", force=True)
+            t0 = time.perf_counter()
+            with tracer.span("bench/bank", label=label):
+                src = get_cohort_data(mcfg)
+            bank_s = time.perf_counter() - t0
+            bank_bytes = sum(
+                os.path.getsize(os.path.join(src.bank.dir, f))
+                for f in os.listdir(src.bank.dir))
+            params = init_params(model, fed.train.images.shape[2:],
+                                 jax.random.PRNGKey(0))
+            base_key = jax.random.PRNGKey(0)
+            fn = (make_chained_cohort_round_fn(mcfg, model, norm)
+                  if chain > 1 else make_cohort_round_fn(mcfg, model, norm))
+
+            def gather_unit(unit):
+                ids = [cohort_mod.sample_cohort_host(mcfg, r)[0]
+                       for r in unit]
+                rows = [src.gather_cohort(i) for i in ids]
+                if len(unit) == 1:
+                    return tuple(map(jnp.asarray, rows[0]))
+                return tuple(jnp.asarray(np.stack([r[k] for r in rows]))
+                             for k in range(3))
+
+            n_blocks = args.blocks + 1   # block 0 = compile + warmup
+            sched = [tuple(range(b * chain + 1, (b + 1) * chain + 1))
+                     for b in range(n_blocks)]
+            pre = RoundPrefetcher(gather_unit, sched, depth=1)
+            try:
+                def run_block(params, b):
+                    payload = pre.get(sched[b])
+                    if chain > 1:
+                        ids = jnp.asarray(sched[b], jnp.int32)
+                        return fn(params, base_key, ids, *payload)[0]
+                    return fn(params, base_key, jnp.int32(sched[b][0]),
+                              *payload)[0]
+
+                hb.update(phase="compile", compile_in_flight=True,
+                          force=True)
+                t0 = time.perf_counter()
+                with tracer.span("bench/cohort_first", label=label):
+                    params = run_block(params, 0)
+                    jax.block_until_ready(params)
+                compile_s = time.perf_counter() - t0
+                hb.update(phase="measure", compile_in_flight=False,
+                          force=True)
+                t0 = time.perf_counter()
+                with tracer.span("bench/cohort_steady", label=label,
+                                 blocks=args.blocks):
+                    for b in range(1, n_blocks):
+                        params = run_block(params, b)
+                    jax.block_until_ready(params)
+                elapsed = time.perf_counter() - t0
+            finally:
+                pre.close()
+            r = args.blocks * chain / elapsed
+            log(f"[bench]{label} {args.blocks * chain} rounds in "
+                f"{elapsed:.2f}s -> {r:.3f} rounds/sec steady-state "
+                f"(bank {bank_bytes / 2**20:.1f} MiB in {bank_s:.1f}s, "
+                f"compile+first {compile_s:.1f}s)")
+            return r, compile_s, bank_s, bank_bytes
+
+        # (1) equal-cohort A/B on the flagship: same population, same
+        # shards (label_shards), same shapes — cohort machinery only.
+        # The cohort program always carries the active mask, so it never
+        # takes the fused Pallas server step; a pallas-on dense baseline
+        # would fold the kernel's win into "cohort overhead" (same
+        # re-measure the faults/telemetry probes do)
+        r_dense = rounds_per_sec
+        if cfg.use_pallas:
+            log("[bench] --population_ladder: re-measuring the dense "
+                "baseline without the Pallas kernel for a like-for-like "
+                "cohort-overhead figure")
+            _, r_dense, _, _ = measure(cfg.replace(use_pallas=False),
+                                       label="[dense, no pallas]")
+        ab_cfg = cfg.replace(cohort_sampled="on",
+                             cohort_size=cfg.agents_per_round,
+                             partitioner="label_shards",
+                             use_pallas=False)
+        r_ab, c_ab, _, _ = measure_cohort(
+            ab_cfg, f"[cohort K={cfg.num_agents}]")
+        population_out = {
+            "cohort_size": cfg.agents_per_round,
+            "dense_rounds_per_sec": round(r_dense, 4),
+            "equal_cohort_rounds_per_sec": round(r_ab, 4),
+            "cohort_overhead_pct": round(
+                100.0 * (1.0 - r_ab / r_dense), 2),
+            "equal_cohort_compile_s": round(c_ab, 1),
+            "ladder": [],
+        }
+        log(f"[bench] equal-cohort overhead vs dense: "
+            f"{population_out['cohort_overhead_pct']}%")
+
+        # (2) the population ladder, ascending so the monotone RSS
+        # watermark judges flatness. samples_per_client is resolved ONCE
+        # (auto would resolve per rung — clip(n/K) shrinks with K — and
+        # different max_n per rung would break the rungs'
+        # compute-comparability the r9 template relies on); the largest
+        # rung's auto value lands on every rung.
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            bank as bank_mod)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+            get_datasets)
+        def current_rss_bytes():
+            # ru_maxrss is the PROCESS-lifetime peak — the dense headline
+            # measured above may dominate it, making a flat peak ladder
+            # vacuous. The instantaneous VmRSS per rung is the signal
+            # that would actually expose O(population) growth in-process
+            # (the CI population-smoke job measures each rung in its own
+            # process for the rigorous watermark).
+            try:
+                with open("/proc/self/status") as f:
+                    for line in f:
+                        if line.startswith("VmRSS:"):
+                            return int(line.split()[1]) * 1024
+            except OSError:
+                pass
+            return None
+
+        rungs = sorted(int(x) for x in
+                       args.population_ladder.split(",") if x.strip())
+        base_train, _, _ = get_datasets(cfg)
+        if isinstance(base_train, list):
+            raise ValueError(
+                "the population ladder needs a single base dataset to "
+                "index (pre-split per-user data cannot be re-partitioned)")
+        ladder_spc = bank_mod.resolve_samples_per_client(
+            args.ladder_spc, len(base_train.labels), max(rungs))
+        population_out["ladder_samples_per_client"] = ladder_spc
+        log(f"[bench] ladder samples/client: {ladder_spc} (same on "
+            f"every rung)")
+        for pop in rungs:
+            rung_cfg = cfg.replace(
+                num_agents=pop, cohort_sampled="on",
+                cohort_size=cfg.agents_per_round,
+                partitioner=args.ladder_partitioner,
+                samples_per_client=ladder_spc)
+            r, c_s, bank_s, bank_bytes = measure_cohort(
+                rung_cfg, f"[population {pop}]")
+            rss = obs_attribution.host_watermarks()
+            cur = current_rss_bytes()
+            if cur is not None:
+                rss["host_rss_bytes"] = cur
+            rung_hbm = obs_attribution.memory_watermarks()
+            row = {"population": pop,
+                   "rounds_per_sec": round(r, 4),
+                   "compile_s": round(c_s, 1),
+                   "bank_build_s": round(bank_s, 1),
+                   "bank_bytes": bank_bytes,
+                   **rss, **rung_hbm}
+            population_out["ladder"].append(row)
+            log(f"[bench] rung {pop:,}: {r:.3f} rounds/sec, host RSS "
+                f"{(cur or 0) / 2**30:.2f} GiB now / "
+                f"{rss.get('host_peak_rss_bytes', 0) / 2**30:.2f} GiB "
+                f"peak")
+
     # performance anatomy (VERDICT r2 weak #1): FLOPs/round from XLA's own
     # cost analysis of the compiled client step, and MFU against the chip's
     # bf16 peak — "actually fast, or just correct?" on the record
@@ -643,6 +848,8 @@ def main():
         out["faults"] = faults_out
     if telemetry_out is not None:
         out["telemetry"] = telemetry_out
+    if population_out is not None:
+        out["population"] = population_out
     if attribution_out is not None:
         out["attribution"] = attribution_out
     if hbm:

@@ -285,6 +285,40 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         collective_budget={**zero, "psum": 2 * n_leaves + 2,
                            "all_gather": 1},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # cohort-sampled population axis (ISSUE 7, data/cohort.py): the
+    # in-program cohort draw + active mask are replicated computations
+    # feeding the participation-mask protocol — the acceptance claim is
+    # ZERO collectives beyond the plain family's plan (the vmap cohort
+    # family stays collective-free; the sharded budget is unchanged;
+    # cohort + churn composes presence into the draw for free; cohort +
+    # faults still costs only the one [m]-bit validation all_gather).
+    # The HLO ceilings carry the same measured +3 GSPMD partitioner
+    # constant as every sharded family (analysis_baseline.json pins 21
+    # all-reduces = the 18-psum plan + 3).
+    coh = {"cohort_sampled": "on"}
+    specs["vmap_rlr_avg_cohort"] = CheckSpec(
+        name="vmap_rlr_avg_cohort", family="round_cohort", sharded=False,
+        cfg_overrides=dict(coh), collective_budget=dict(zero))
+    specs["sharded_rlr_avg_cohort"] = CheckSpec(
+        name="sharded_rlr_avg_cohort", family="round_sharded_cohort",
+        sharded=True, cfg_overrides=dict(coh),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_churn"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_churn", family="round_sharded_cohort",
+        sharded=True, cfg_overrides={**coh, **churn},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_faults"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_faults",
+        family="round_sharded_cohort", sharded=True,
+        cfg_overrides={**coh, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
     return specs
 
 
@@ -307,6 +341,10 @@ PROGRAM_READ_MODULES = (
     f"{PKG}/faults/",
     f"{PKG}/obs/telemetry.py",
     f"{PKG}/models/",
+    # in-program cohort sampling (ISSUE 7): the traced draw reads
+    # cohort_seed / num_agents / agents_per_round (+ churn fields via
+    # service/churn.py) — all program provenance
+    f"{PKG}/data/cohort.py",
 )
 
 # Provenance classes (config.FIELD_PROVENANCE values) and their

@@ -40,6 +40,36 @@ def select_poison_idxs(labels: np.ndarray, base_class: int, frac: float,
     return rng.choice(cand_idxs, size=k, replace=False)
 
 
+def poison_client_row(images_row: np.ndarray, labels_row: np.ndarray,
+                      size: int, agent_id: int, cfg, *, stamp=None,
+                      seed_offset: int = 1234) -> np.ndarray:
+    """Poison ONE client's padded row *in place* — the per-agent body of
+    `poison_agent_shards`, factored out so the cohort-gather path
+    (data/bank.py: rows materialized per sampled cohort member, not at
+    build time) stamps bitwise-identical pixels: the index choice is a
+    pure function of (cfg.seed, agent_id) and the row content, never of
+    when or how often the row is gathered.
+
+    images_row: [max_n, H, W, C] raw pixels; labels_row: [max_n];
+    `size` the true shard length. Returns the [max_n] poison mask."""
+    max_n = labels_row.shape[0]
+    mask = np.zeros((max_n,), dtype=bool)
+    if stamp is None:
+        stamp = build_stamp(cfg.data, cfg.pattern_type, agent_idx=agent_id,
+                            data_dir=cfg.data_dir)
+    rng = np.random.default_rng(cfg.seed + seed_offset + agent_id)
+    valid = np.arange(max_n) < size
+    idxs = select_poison_idxs(labels_row, cfg.base_class, cfg.poison_frac,
+                              rng, valid=valid)
+    if len(idxs) == 0:
+        return mask
+    images_row[idxs] = np.asarray(
+        apply_stamp(images_row[idxs], stamp)).astype(images_row.dtype)
+    labels_row[idxs] = cfg.target_class
+    mask[idxs] = True
+    return mask
+
+
 def poison_agent_shards(images: np.ndarray, labels: np.ndarray,
                         sizes: np.ndarray, cfg, *,
                         seed_offset: int = 1234) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -54,18 +84,9 @@ def poison_agent_shards(images: np.ndarray, labels: np.ndarray,
     K, max_n = labels.shape
     poison_mask = np.zeros((K, max_n), dtype=bool)
     for aid in range(min(cfg.num_corrupt, K)):
-        stamp = build_stamp(cfg.data, cfg.pattern_type, agent_idx=aid,
-                            data_dir=cfg.data_dir)
-        rng = np.random.default_rng(cfg.seed + seed_offset + aid)
-        valid = np.arange(max_n) < sizes[aid]
-        idxs = select_poison_idxs(labels[aid], cfg.base_class, cfg.poison_frac,
-                                  rng, valid=valid)
-        if len(idxs) == 0:
-            continue
-        images[aid, idxs] = np.asarray(
-            apply_stamp(images[aid, idxs], stamp)).astype(images.dtype)
-        labels[aid, idxs] = cfg.target_class
-        poison_mask[aid, idxs] = True
+        poison_mask[aid] = poison_client_row(images[aid], labels[aid],
+                                             int(sizes[aid]), aid, cfg,
+                                             seed_offset=seed_offset)
     return images, labels, poison_mask
 
 

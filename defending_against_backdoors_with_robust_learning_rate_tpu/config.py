@@ -125,6 +125,35 @@ class Config:
                                     # independent of --seed so the cohort
                                     # process can be re-drawn without
                                     # touching any training key stream
+    # --- million-client population axis (data/bank.py + data/cohort.py) ---
+    cohort_sampled: str = "auto"    # auto | on | off — decouple population
+                                    # from cohort: the round program takes
+                                    # the traced round index, recomputes
+                                    # the seeded cohort ids in-program,
+                                    # and trains only the gathered [m,...]
+                                    # cohort stacks. auto turns on at
+                                    # populations >= 4096 clients
+                                    # (utils/compile_cache.is_cohort_mode)
+    cohort_size: int = 0            # per-round cohort m; 0 = the legacy
+                                    # floor(num_agents * agent_frac)
+    cohort_seed: int = 0            # seeds the cohort stream — its own
+                                    # program field (like churn_seed) so
+                                    # cohorts can be re-drawn without
+                                    # touching any training key stream
+    partitioner: str = "label_shards"  # client-bank partitioner:
+                                    # label_shards (the paper's exact
+                                    # dealing scheme) | dirichlet |
+                                    # pathological (per-client-seeded,
+                                    # scale to millions of clients)
+    dirichlet_alpha: float = 0.5    # Dir(alpha) class-mixture concentration
+    classes_per_client: int = 2     # pathological: distinct classes/client
+    samples_per_client: int = 0     # virtual-partitioner shard size;
+                                    # 0 = auto clamp(n/K, 16, 4096)
+    bank_dir: str = ""              # client-bank root ("" = auto under
+                                    # data_dir, else log_dir)
+    bank_shard_clients: int = 65536  # clients per bank index-shard file
+                                    # (IO layout only — bank content is
+                                    # provably layout-independent)
     # --- continuous-service driver (service/driver.py) ---
     service_rounds: int = 0         # serve(): total rounds to stream; 0 =
                                     # indefinitely (until the stop file
@@ -225,9 +254,13 @@ class Config:
 
     @property
     def agents_per_round(self) -> int:
-        """floor(K * C) sampled agents per round (src/federated.py:68)."""
+        """The per-round cohort m: an explicit --cohort_size wins (the
+        population/cohort decoupling knob, ISSUE 7); otherwise the
+        reference's floor(K * C) (src/federated.py:68)."""
         import math
 
+        if self.cohort_size > 0:
+            return self.cohort_size
         return max(1, math.floor(self.num_agents * self.agent_frac))
 
     @property
@@ -322,6 +355,19 @@ FIELD_PROVENANCE = {
                                    # (PRNGKey(churn_seed) is a program
                                    # constant, unlike --seed whose keys are
                                    # program ARGUMENTS)
+    "cohort_sampled": "runtime",   # selects the cohort program families;
+                                   # family names key the fingerprint
+    "cohort_size": "program",      # m: vmap width + in-program sampling
+    "cohort_seed": "program",      # baked into the traced cohort draw
+                                   # (data/cohort.py, like churn_seed)
+    "partitioner": "data",         # shapes bank CONTENT, never the program
+    "dirichlet_alpha": "data",
+    "classes_per_client": "data",
+    "samples_per_client": "shape",  # cohort-row length via the bank's
+                                    # padded max_n -> pinned by the avals
+    "bank_dir": "runtime",         # storage location only
+    "bank_shard_clients": "runtime",  # IO shard layout; bank content is
+                                      # layout-independent (test-pinned)
     "service_rounds": "runtime",   # service/driver.py streaming budget
     "service_retries": "runtime",  # supervisor policy (service/supervisor)
     "service_backoff_s": "runtime",
@@ -495,6 +541,46 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--churn_seed", type=int, default=d.churn_seed,
                    help="seeds the client lifecycle streams (independent "
                         "of --seed)")
+    p.add_argument("--cohort_sampled", choices=("auto", "on", "off"),
+                   default=d.cohort_sampled,
+                   help="population/cohort decoupling (data/bank.py + "
+                        "data/cohort.py): the round trains a seeded "
+                        "per-round cohort gathered from a sharded "
+                        "memory-mapped client bank — host/HBM memory is "
+                        "constant in population size (auto: on at >= "
+                        "4096 clients)")
+    p.add_argument("--cohort_size", type=int, default=d.cohort_size,
+                   help="per-round cohort size m (0 = the legacy "
+                        "floor(num_agents * agent_frac))")
+    p.add_argument("--cohort_seed", type=int, default=d.cohort_seed,
+                   help="seeds the per-round cohort draw (independent of "
+                        "--seed; a program constant like --churn_seed)")
+    p.add_argument("--partitioner",
+                   choices=("label_shards", "dirichlet", "pathological"),
+                   default=d.partitioner,
+                   help="client-bank partitioner: label_shards = the "
+                        "paper's dealing scheme (exact, small K); "
+                        "dirichlet / pathological = per-client-seeded "
+                        "non-IID draws that scale to millions of clients")
+    p.add_argument("--dirichlet_alpha", type=float,
+                   default=d.dirichlet_alpha,
+                   help="Dirichlet class-mixture concentration (smaller = "
+                        "more skewed clients)")
+    p.add_argument("--classes_per_client", type=int,
+                   default=d.classes_per_client,
+                   help="pathological partitioner: distinct classes each "
+                        "client sees")
+    p.add_argument("--samples_per_client", type=int,
+                   default=d.samples_per_client,
+                   help="virtual-partitioner shard size (0 = auto "
+                        "clamp(n_samples/population, 16, 4096))")
+    p.add_argument("--bank_dir", type=str, default=d.bank_dir,
+                   help="client-bank root (default: "
+                        "<data_dir>/client_banks/, else under log_dir)")
+    p.add_argument("--bank_shard_clients", type=int,
+                   default=d.bank_shard_clients,
+                   help="clients per bank index-shard file (IO layout "
+                        "only; content is layout-independent)")
     p.add_argument("--service_rounds", type=int, default=d.service_rounds,
                    help="service mode: total rounds to stream (0 = run "
                         "until <log_dir>/service.stop appears)")

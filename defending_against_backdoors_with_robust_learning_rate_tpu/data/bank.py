@@ -1,0 +1,412 @@
+"""Sharded, memory-mapped client bank — the million-client population store.
+
+The dense layout (data/arrays.AgentShards) materializes every client's
+shard as one [K, max_n, ...] host/HBM array, welding population size to
+per-round cohort size: a 1M-client population would need terabytes before
+the first round runs. FedJAX (arXiv:2108.02117) identifies the right
+simulator primitives instead — client *sampling* plus for-each-client
+batching — which only ever touch the sampled cohort. This module is the
+storage half of that split:
+
+- **partition once, store offsets**: the population is partitioned into
+  per-client *index lists* over the base dataset (the samples themselves
+  are never duplicated). The flat int64 index stream is written to sharded
+  ``indices-<i>.bin`` files (``shard_clients`` clients per file) plus a
+  memory-mapped ``offsets.npy`` [K+1] — an offset-indexed store whose
+  resident set is O(touched cohort), not O(population).
+- **partitioners that scale**: ``dirichlet`` and ``pathological`` draw
+  each client's shard as a pure per-client function of ``(seed, client)``
+  (generated in fixed 4096-client blocks, vectorized numpy), so a 1M-client
+  partition streams through constant memory and its content is independent
+  of the shard layout, the build order, and the building process —
+  fingerprint-stable by construction (``content_sha``). ``label_shards``
+  wraps the paper's reference partitioner (data/partition.py) for
+  populations small enough to partition exactly; its bank rows are
+  bitwise-identical to the dense ``stack_agent_shards`` layout.
+- **cohort gather**: ``ClientBank.gather`` materializes only the m sampled
+  clients' rows as a padded [m, max_n, ...] stack (the static shape one
+  compiled round program consumes forever), fancy-indexing the base
+  dataset through the memmapped index lists.
+
+This module is numpy-only on purpose: bank builds run in subprocesses and
+CI jobs that never initialize a jax backend, and the determinism tests
+compare content hashes across processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
+    padded_max_n)
+
+BANK_VERSION = 1
+META_NAME = "meta.json"
+OFFSETS_NAME = "offsets.npy"
+
+# fixed generation block for the per-client-seeded partitioners: content is
+# a function of (seed, block index) with BUILD_BLOCK a named constant, so
+# the partition never depends on `shard_clients` (an IO layout knob) or on
+# how many clients one build call handles
+BUILD_BLOCK = 4096
+
+PARTITIONERS = ("label_shards", "dirichlet", "pathological")
+
+# samples_per_client auto-resolution bounds (resolve_samples_per_client)
+MIN_SAMPLES_PER_CLIENT = 16
+MAX_SAMPLES_PER_CLIENT = 4096
+
+
+def resolve_samples_per_client(requested: int, n_samples: int,
+                               population: int) -> int:
+    """``--samples_per_client 0`` = auto: an even split of the base dataset
+    clamped to [16, 4096] — at 1M clients over a 60k-sample dataset every
+    client still holds a trainable (16-sample) shard drawn with
+    replacement."""
+    if requested > 0:
+        return requested
+    return int(np.clip(n_samples // max(population, 1),
+                       MIN_SAMPLES_PER_CLIENT, MAX_SAMPLES_PER_CLIENT))
+
+
+def bank_key(labels: np.ndarray, *, population: int, partitioner: str,
+             samples_per_client: int, dirichlet_alpha: float,
+             classes_per_client: int, seed: int, n_classes: int) -> str:
+    """Input fingerprint deciding bank reuse: dataset content (labels) +
+    every partition-shaping parameter. The shard layout
+    (``shard_clients``) and the gather-time padding (``pad_multiple`` —
+    applied by ``padded_max_n`` when rows are materialized, never at
+    build) are deliberately NOT part of the key: neither can change the
+    stored content, so e.g. a batch-size change reuses the bank."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(labels, dtype=np.int64).tobytes())
+    h.update(json.dumps({
+        "version": BANK_VERSION, "population": population,
+        "partitioner": partitioner,
+        "samples_per_client": samples_per_client,
+        "dirichlet_alpha": dirichlet_alpha,
+        "classes_per_client": classes_per_client,
+        "seed": seed, "n_classes": n_classes,
+    }, sort_keys=True).encode())
+    return h.hexdigest()[:20]
+
+
+def _class_pools(labels: np.ndarray, n_classes: int) -> List[np.ndarray]:
+    return [np.nonzero(labels == c)[0].astype(np.int64)
+            for c in range(n_classes)]
+
+
+def _block_rng(seed: int, block: int) -> np.random.Generator:
+    # SeedSequence([...]) keys the stream by (constant, seed, block): two
+    # builds of the same config produce identical blocks in any order
+    return np.random.default_rng([0xBA4C, seed, block])
+
+
+def _draw_block(rng: np.random.Generator, counts: np.ndarray,
+                pools: List[np.ndarray]) -> np.ndarray:
+    """[B, spc] sample indices from per-(client, class) `counts` [B, C]
+    (rows sum to spc): class-major draws scattered back to clients.
+
+    Within a client the row is ordered class-major then draw-order — a
+    deterministic function of the rng stream alone (np.argsort stable)."""
+    B = counts.shape[0]
+    owners, vals = [], []
+    for c, pool in enumerate(pools):
+        tot = int(counts[:, c].sum())
+        if tot == 0:
+            continue
+        vals.append(pool[rng.integers(0, len(pool), size=tot)])
+        owners.append(np.repeat(np.arange(B), counts[:, c]))
+    owner = np.concatenate(owners)
+    order = np.argsort(owner, kind="stable")
+    return np.concatenate(vals)[order].reshape(B, -1)
+
+
+def _dirichlet_block(rng: np.random.Generator, block_size: int,
+                     pools: List[np.ndarray], spc: int,
+                     alpha: float) -> np.ndarray:
+    """Per-client Dir(alpha) class mixtures -> multinomial counts -> index
+    draws. Classes with empty pools get zero mass (a dataset missing a
+    class cannot be sampled from)."""
+    C = len(pools)
+    nonempty = np.array([len(p) > 0 for p in pools])
+    g = rng.standard_gamma(alpha, size=(block_size, C))
+    g = np.where(nonempty[None, :], np.maximum(g, 1e-30), 0.0)
+    p = g / g.sum(axis=1, keepdims=True)
+    counts = rng.multinomial(spc, p)
+    return _draw_block(rng, counts, pools)
+
+
+def _pathological_block(rng: np.random.Generator, block_size: int,
+                        pools: List[np.ndarray], spc: int,
+                        classes_per_client: int) -> np.ndarray:
+    """The classic pathological non-IID split: each client sees only
+    `classes_per_client` distinct (nonempty) classes, samples split evenly
+    (remainder to the client's first picks)."""
+    C = len(pools)
+    nonempty = np.nonzero([len(p) > 0 for p in pools])[0]
+    cpc = min(classes_per_client, len(nonempty))
+    scores = rng.random((block_size, len(nonempty)))
+    picks = nonempty[np.argsort(scores, axis=1, kind="stable")[:, :cpc]]
+    base, rem = divmod(spc, cpc)
+    counts = np.zeros((block_size, C), dtype=np.int64)
+    rows = np.arange(block_size)[:, None]
+    np.add.at(counts, (np.broadcast_to(rows, picks.shape), picks), base)
+    if rem:
+        np.add.at(counts, (np.broadcast_to(rows, picks[:, :rem].shape),
+                           picks[:, :rem]), 1)
+    return _draw_block(rng, counts, pools)
+
+
+def _iter_client_lists(labels: np.ndarray, *, population: int,
+                       partitioner: str, spc: int, alpha: float,
+                       classes_per_client: int, seed: int, n_classes: int):
+    """Yield (first_client_id, [per-client int64 index arrays]) in client
+    order, in bounded chunks — the streaming source every build consumes."""
+    if partitioner == "label_shards":
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            native)
+        groups = native.distribute_data(labels, population,
+                                        n_classes=n_classes)
+        for start in range(0, population, BUILD_BLOCK):
+            stop = min(start + BUILD_BLOCK, population)
+            yield start, [np.asarray(list(groups.get(a, ())), dtype=np.int64)
+                          for a in range(start, stop)]
+        return
+    if partitioner not in PARTITIONERS:
+        raise ValueError(f"partitioner must be one of {PARTITIONERS}, "
+                         f"got {partitioner!r}")
+    pools = _class_pools(labels, n_classes)
+    if not any(len(p) for p in pools):
+        raise ValueError("cannot partition an empty dataset")
+    for start in range(0, population, BUILD_BLOCK):
+        stop = min(start + BUILD_BLOCK, population)
+        rng = _block_rng(seed, start // BUILD_BLOCK)
+        if partitioner == "dirichlet":
+            block = _dirichlet_block(rng, stop - start, pools, spc, alpha)
+        else:
+            block = _pathological_block(rng, stop - start, pools, spc,
+                                        classes_per_client)
+        yield start, list(block)
+
+
+@dataclasses.dataclass
+class ClientBank:
+    """An opened bank: memmapped offsets + lazily-memmapped index shards.
+
+    ``offsets`` is np.load(mmap_mode="r") — O(population) bytes stay on
+    disk; a cohort gather touches m+1 entries. Shard memmaps open on first
+    use and are views, never copies."""
+
+    dir: str
+    meta: Dict
+    offsets: np.ndarray                       # int64 [K+1] (memmap)
+    _shards: Dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    @property
+    def population(self) -> int:
+        return int(self.meta["population"])
+
+    @property
+    def max_client_n(self) -> int:
+        return int(self.meta["max_client_n"])
+
+    @property
+    def shard_clients(self) -> int:
+        return int(self.meta["shard_clients"])
+
+    def padded_max_n(self, pad_multiple: int = 1) -> int:
+        """The static cohort-row length: max client shard size rounded up
+        exactly like the dense layout (data/arrays.padded_max_n), so a
+        label_shards bank row is bitwise the dense stacked row."""
+        return padded_max_n(np.asarray([self.max_client_n]), pad_multiple)
+
+    def _shard(self, i: int) -> np.ndarray:
+        mm = self._shards.get(i)
+        if mm is None:
+            path = os.path.join(self.dir, f"indices-{i:05d}.bin")
+            mm = np.memmap(path, dtype=np.int64, mode="r")
+            self._shards[i] = mm
+        return mm
+
+    def client_indices(self, cid: int) -> np.ndarray:
+        """This client's sample-index list (a memmap view)."""
+        cid = int(cid)
+        lo, hi = int(self.offsets[cid]), int(self.offsets[cid + 1])
+        if lo == hi:
+            # an empty shard must not touch the shard file (a shard whose
+            # clients are all empty is a 0-byte file np.memmap rejects)
+            return np.empty((0,), dtype=np.int64)
+        s = cid // self.shard_clients
+        base = int(self.offsets[s * self.shard_clients])
+        return self._shard(s)[lo - base:hi - base]
+
+    def sizes_of(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, dtype=np.int64)
+        off = self.offsets
+        return (off[ids + 1] - off[ids]).astype(np.int32)
+
+    def gather(self, ids, images: np.ndarray, labels: np.ndarray,
+               max_n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The cohort's padded stacks: ([m, max_n, ...] images, [m, max_n]
+        labels, [m] sizes) — the exact AgentShards row layout, built for
+        the m sampled clients only."""
+        ids = np.asarray(ids, dtype=np.int64)
+        m = len(ids)
+        out_img = np.zeros((m, max_n) + images.shape[1:], dtype=images.dtype)
+        out_lbl = np.zeros((m, max_n), dtype=np.int32)
+        sizes = np.zeros((m,), dtype=np.int32)
+        for j, cid in enumerate(ids):
+            idx = np.asarray(self.client_indices(cid))
+            n = len(idx)
+            sizes[j] = n
+            if n:
+                out_img[j, :n] = images[idx]
+                out_lbl[j, :n] = labels[idx]
+        return out_img, out_lbl, sizes
+
+    @classmethod
+    def open(cls, bank_dir: str) -> "ClientBank":
+        with open(os.path.join(bank_dir, META_NAME)) as f:
+            meta = json.load(f)
+        if meta.get("version") != BANK_VERSION:
+            raise ValueError(f"bank {bank_dir!r}: version "
+                             f"{meta.get('version')} != {BANK_VERSION}")
+        offsets = np.load(os.path.join(bank_dir, OFFSETS_NAME),
+                          mmap_mode="r")
+        return cls(bank_dir, meta, offsets)
+
+
+def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
+               partitioner: str = "dirichlet", samples_per_client: int = 0,
+               dirichlet_alpha: float = 0.5, classes_per_client: int = 2,
+               seed: int = 0, n_classes: int = 10,
+               shard_clients: int = 65536, key: Optional[str] = None,
+               log=print) -> ClientBank:
+    """Partition once into an offset-indexed store. Streams: peak memory is
+    O(BUILD_BLOCK * samples_per_client) regardless of population. The
+    build lands in a temp dir and is renamed into place atomically, so a
+    concurrent builder (or a killed one) can never leave a half-bank that
+    opens. `key` is the precomputed bank_key of these exact inputs
+    (callers that already paid the labels hash pass it through)."""
+    labels = np.asarray(labels)
+    spc = resolve_samples_per_client(samples_per_client, len(labels),
+                                     population)
+    shard_clients = max(1, int(shard_clients))
+    if key is None:
+        key = bank_key(labels, population=population,
+                       partitioner=partitioner, samples_per_client=spc,
+                       dirichlet_alpha=dirichlet_alpha,
+                       classes_per_client=classes_per_client, seed=seed,
+                       n_classes=n_classes)
+    tmp = f"{bank_dir}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    offsets = np.zeros(population + 1, dtype=np.int64)
+    sha = hashlib.sha256()
+    max_client_n = 0
+    total = 0
+    shard_f = None
+    shard_id = -1
+    try:
+        for start, lists in _iter_client_lists(
+                labels, population=population, partitioner=partitioner,
+                spc=spc, alpha=dirichlet_alpha,
+                classes_per_client=classes_per_client, seed=seed,
+                n_classes=n_classes):
+            for j, idx in enumerate(lists):
+                cid = start + j
+                s = cid // shard_clients
+                if s != shard_id:
+                    if shard_f is not None:
+                        shard_f.close()
+                    shard_id = s
+                    shard_f = open(os.path.join(
+                        tmp, f"indices-{s:05d}.bin"), "wb")
+                buf = np.ascontiguousarray(idx, dtype=np.int64).tobytes()
+                shard_f.write(buf)
+                sha.update(buf)
+                n = len(idx)
+                max_client_n = max(max_client_n, n)
+                total += n
+                offsets[cid + 1] = total
+    finally:
+        if shard_f is not None:
+            shard_f.close()
+    np.save(os.path.join(tmp, OFFSETS_NAME), offsets)
+    meta = {
+        "version": BANK_VERSION, "key": key, "content_sha": sha.hexdigest(),
+        "population": population, "partitioner": partitioner,
+        "samples_per_client": spc, "dirichlet_alpha": dirichlet_alpha,
+        "classes_per_client": classes_per_client, "seed": seed,
+        "n_classes": n_classes, "shard_clients": shard_clients,
+        "n_base_samples": int(len(labels)),
+        "total_indices": int(total), "max_client_n": int(max_client_n),
+        "n_shards": (population + shard_clients - 1) // shard_clients,
+    }
+    with open(os.path.join(tmp, META_NAME), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    if os.path.isdir(bank_dir):
+        # a racing builder finished first: its content is identical by
+        # construction (same key); keep it
+        shutil.rmtree(tmp)
+    else:
+        try:
+            os.replace(tmp, bank_dir)
+        except OSError:
+            # check-then-replace race: a concurrent builder published
+            # between the isdir check and the rename (os.replace cannot
+            # overwrite a non-empty dir). Same key => same content; keep
+            # the winner's
+            if not os.path.isdir(bank_dir):
+                raise
+            shutil.rmtree(tmp)
+    log(f"[bank] {partitioner} partition of {population:,} clients "
+        f"({total:,} index rows, max shard {max_client_n}, "
+        f"{meta['n_shards']} shard file(s)) -> {bank_dir}")
+    return ClientBank.open(bank_dir)
+
+
+def get_or_build(bank_dir: str, labels: np.ndarray, *, population: int,
+                 partitioner: str, samples_per_client: int,
+                 dirichlet_alpha: float, classes_per_client: int,
+                 seed: int, n_classes: int, shard_clients: int,
+                 key: Optional[str] = None, log=print
+                 ) -> Tuple[ClientBank, bool]:
+    """Open `bank_dir` when its key matches this config, else (re)build.
+    Returns (bank, built). `key` = precomputed bank_key of these inputs
+    (the labels sha256 is the expensive part — callers that already
+    computed it to resolve the bank dir pass it through)."""
+    labels = np.asarray(labels)
+    spc = resolve_samples_per_client(samples_per_client, len(labels),
+                                     population)
+    if key is None:
+        key = bank_key(labels, population=population,
+                       partitioner=partitioner, samples_per_client=spc,
+                       dirichlet_alpha=dirichlet_alpha,
+                       classes_per_client=classes_per_client, seed=seed,
+                       n_classes=n_classes)
+    meta_path = os.path.join(bank_dir, META_NAME)
+    if os.path.exists(meta_path):
+        try:
+            bank = ClientBank.open(bank_dir)
+            if bank.meta.get("key") == key:
+                return bank, False
+            log(f"[bank] {bank_dir}: key mismatch "
+                f"(have {bank.meta.get('key')}, want {key}); rebuilding")
+        except (OSError, ValueError) as e:
+            log(f"[bank] {bank_dir}: unreadable ({e}); rebuilding")
+        shutil.rmtree(bank_dir, ignore_errors=True)
+    bank = build_bank(bank_dir, labels, population=population,
+                      partitioner=partitioner, samples_per_client=spc,
+                      dirichlet_alpha=dirichlet_alpha,
+                      classes_per_client=classes_per_client, seed=seed,
+                      n_classes=n_classes, shard_clients=shard_clients,
+                      key=key, log=log)
+    return bank, True

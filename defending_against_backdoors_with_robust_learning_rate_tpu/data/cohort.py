@@ -1,0 +1,130 @@
+"""Seeded, fingerprint-stable per-round cohort sampling — computed inside
+the round program AND mirrored on host with the same jax ops.
+
+The population/cohort split (data/bank.py stores the population; this
+module picks each round's cohort) needs ONE sampling function with three
+properties:
+
+- **in-program**: the round program receives only the round index (a
+  traced int32, like the churn lead arg) and recomputes the cohort ids
+  itself — corrupt flags (``ids < num_corrupt``) and the churn lifecycle
+  mask derive in-jit from real client ids, so the host never ships flag
+  arguments and the metrics layer attributes Defense/Faults over *cohort
+  membership*, not slot position.
+- **host-mirrorable**: the driver must gather the SAME clients' data
+  before dispatch. ``host_sampler`` jits the identical function once per
+  config; same ops + same PRNG impl => bit-identical ids on both sides.
+- **fingerprint-stable**: the draw is a pure function of ``cohort_seed``
+  (its own `program` config field, like ``churn_seed``) and the traced
+  round index — never of runtime knobs — so one AOT-banked executable
+  serves every round and every resume.
+
+Sampling model (O(cohort), never O(population)): draw ``C`` candidate ids
+with replacement (C = an oversample of m, scaled by churn availability),
+mark each candidate *eligible* iff it is the first occurrence of its id
+(dedup) AND its client is churn-present this round
+(service/churn.active_slots — cohorts are sampled from the present set,
+retiring the host-sampled + churn refusal), then take the first m
+eligible candidates. If fewer than m are eligible (tiny populations,
+deep churn), the cohort is padded with ineligible candidates whose
+``active=False`` flag routes them into the participation mask — they are
+excluded from aggregation exactly like a dropped client, so correctness
+degrades gracefully instead of ever resampling with a different shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fold_in tag separating the cohort stream from every other PRNGKey stream
+# (churn uses 0xC4A21, faults 0x5FA17)
+COHORT_KEY_TAG = 0xC0407
+
+# candidate-matrix bound: the dedup is an O(C^2) comparison, so cap C
+# (4096^2 bools = 16 MiB of trace-local work — fine; beyond it, raise)
+MAX_CANDIDATES = 4096
+
+
+def cohort_key(cfg):
+    """Base key of the cohort stream (a traced program constant)."""
+    return jax.random.fold_in(jax.random.PRNGKey(cfg.cohort_seed),
+                              COHORT_KEY_TAG)
+
+
+def oversample_count(cfg) -> int:
+    """C: how many candidates one round draws. 2x the cohort, scaled up by
+    churn availability (absent candidates are ineligible), capped at the
+    population-ish scale only through MAX_CANDIDATES."""
+    m = cfg.agents_per_round
+    avail = cfg.churn_available if cfg.churn_enabled else 1.0
+    c = int(np.ceil(2.0 * m / max(float(avail), 0.05)))
+    c = max(c, m + 8)
+    if c > MAX_CANDIDATES:
+        raise ValueError(
+            f"cohort oversample {c} exceeds MAX_CANDIDATES="
+            f"{MAX_CANDIDATES} (cohort {m}, churn_available "
+            f"{cfg.churn_available}); shrink the cohort or raise "
+            f"availability")
+    return c
+
+
+def cohort_feasible(cfg) -> bool:
+    """Can this config's implied cohort be sampled at all? False when the
+    oversample would blow MAX_CANDIDATES (e.g. cohort_size unset at a big
+    population, so m = floor(K * agent_frac) is population-sized).
+    `is_cohort_mode`'s auto path consults this so such configs stay on
+    their historical dense path instead of crashing; an explicit
+    --cohort_sampled on still raises the loud ValueError."""
+    try:
+        oversample_count(cfg)
+    except ValueError:
+        return False
+    return True
+
+
+def sample_cohort(cfg, rnd):
+    """([m] int32 client ids, [m] bool active) for round ``rnd``.
+
+    ``rnd`` may be a traced int32 scalar (inside the round program) or a
+    Python int (the host mirror) — same jax ops, bit-identical answer.
+    ``active`` is False only for shortfall padding (duplicate or
+    churn-absent candidates used to fill the fixed shape); callers AND it
+    into the participation mask."""
+    K, m = cfg.num_agents, cfg.agents_per_round
+    C = oversample_count(cfg)
+    k = jax.random.fold_in(cohort_key(cfg), rnd)
+    cand = jax.random.randint(k, (C,), 0, K, dtype=jnp.int32)
+    # first-occurrence dedup: argmax over the boolean equality row returns
+    # the FIRST matching position
+    eq = cand[:, None] == cand[None, :]
+    first = jnp.argmax(eq, axis=1) == jnp.arange(C)
+    eligible = first
+    if cfg.churn_enabled:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+            churn as churn_mod)
+        with jax.named_scope("cohort_churn_presence"):
+            eligible = eligible & churn_mod.active_slots(cfg, cand, rnd)
+    # stable partition: eligible candidates first, original draw order
+    # preserved on both sides (unique composite keys make any sort stable)
+    key_order = jnp.where(eligible, 0, 1) * C + jnp.arange(C)
+    order = jnp.argsort(key_order)[:m]
+    return cand[order], eligible[order]
+
+
+@functools.lru_cache(maxsize=16)
+def host_sampler(cfg):
+    """The host mirror: a jitted ``rnd -> (ids, active)`` for the gather
+    side (Config is a frozen dataclass, so it keys the cache). One
+    compile per config; per-round cost is one tiny dispatch on the
+    prefetch thread."""
+    return jax.jit(lambda rnd: sample_cohort(cfg, rnd))
+
+
+def sample_cohort_host(cfg, rnd: int):
+    """Numpy (ids, active) for round ``rnd`` — the driver-side mirror."""
+    ids, active = host_sampler(cfg)(jnp.int32(rnd))
+    return np.asarray(ids), np.asarray(active)

@@ -28,6 +28,9 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
+    AgentShards)
+
 # reference normalization constants (src/utils.py:101, 113-116)
 NORM_STATS = {
     "fmnist": ((0.2860,), (0.3530,)),
@@ -64,6 +67,117 @@ class FederatedData:
 def _norm_arrays(data: str) -> Tuple[np.ndarray, np.ndarray]:
     mean, std = NORM_STATS[data]
     return (np.asarray(mean, np.float32), np.asarray(std, np.float32))
+
+
+@dataclasses.dataclass
+class CohortData(FederatedData):
+    """FederatedData for the cohort-sampled population path (ISSUE 7).
+
+    ``train`` holds a ZERO-client AgentShards whose arrays carry only the
+    *shapes and dtypes* one cohort row has ([0, max_n, H, W, C] — zero
+    bytes): everything downstream that reads shard geometry (model init,
+    AOT avals, the host-mode byte check) works unchanged, while the
+    actual population lives in the memory-mapped client bank. Cohort rows
+    are materialized per round by ``gather_cohort`` — base-dataset fancy
+    indexing through the bank's offset store, with corrupt clients'
+    rows poisoned by the same per-client routine the dense build uses
+    (attack/poison.poison_client_row: bitwise-identical shards)."""
+    bank: object = None                  # data/bank.ClientBank
+    base_images: np.ndarray = None       # [N, H, W, C] raw pixels
+    base_labels: np.ndarray = None       # [N] int32
+    max_n: int = 0                       # padded cohort-row length
+    cfg: object = None                   # poison + population params
+    _stamps: dict = dataclasses.field(default_factory=dict)
+
+    def gather_cohort(self, ids) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+        """([m, max_n, ...], [m, max_n], [m]) padded stacks for the
+        sampled cohort — O(cohort) work and memory, population-blind."""
+        from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+            poison)
+        imgs, lbls, sizes = self.bank.gather(ids, self.base_images,
+                                             self.base_labels, self.max_n)
+        cfg = self.cfg
+        if cfg.num_corrupt > 0 and cfg.poison_frac > 0:
+            for j, cid in enumerate(np.asarray(ids)):
+                cid = int(cid)
+                if cid >= cfg.num_corrupt:
+                    continue
+                stamp = self._stamps.get(cid)
+                if stamp is None:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (
+                        build_stamp)
+                    stamp = build_stamp(cfg.data, cfg.pattern_type,
+                                        agent_idx=cid,
+                                        data_dir=cfg.data_dir)
+                    self._stamps[cid] = stamp
+                poison.poison_client_row(imgs[j], lbls[j], int(sizes[j]),
+                                         cid, cfg, stamp=stamp)
+        return imgs, lbls, sizes
+
+
+def resolve_bank_dir(cfg, key: str) -> str:
+    """--bank_dir wins; otherwise banks live under
+    <data_dir>/client_banks/ when data_dir exists (persistent across
+    runs, gitignored), else under log_dir (always writable)."""
+    if cfg.bank_dir:
+        return cfg.bank_dir
+    base = (cfg.data_dir if os.path.isdir(cfg.data_dir) else cfg.log_dir)
+    return os.path.join(base, "client_banks", f"{cfg.data}-{key[:12]}")
+
+
+def get_cohort_data(cfg) -> CohortData:
+    """Build the cohort-sampled data environment: base dataset + client
+    bank (opened when a matching build exists, partitioned once
+    otherwise) + the usual eval sets. Host memory is O(base dataset), not
+    O(population) — the bank is offset-indexed and memory-mapped."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack.poison import (
+        build_poisoned_val)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        bank as bank_mod)
+
+    train, val, synthetic = get_datasets(cfg)
+    if isinstance(train, list):
+        raise ValueError(
+            f"cohort-sampled mode needs a single base dataset to index; "
+            f"{cfg.data!r} loads pre-split per-user shards — run it "
+            f"through the host-sampled path (--cohort_sampled off)")
+    key = bank_mod.bank_key(
+        train.labels, population=cfg.num_agents,
+        partitioner=cfg.partitioner,
+        samples_per_client=bank_mod.resolve_samples_per_client(
+            cfg.samples_per_client, len(train.labels), cfg.num_agents),
+        dirichlet_alpha=cfg.dirichlet_alpha,
+        classes_per_client=cfg.classes_per_client, seed=cfg.seed,
+        n_classes=cfg.n_classes)
+    bank, built = bank_mod.get_or_build(
+        resolve_bank_dir(cfg, key), train.labels,
+        population=cfg.num_agents, partitioner=cfg.partitioner,
+        samples_per_client=cfg.samples_per_client,
+        dirichlet_alpha=cfg.dirichlet_alpha,
+        classes_per_client=cfg.classes_per_client, seed=cfg.seed,
+        n_classes=cfg.n_classes, shard_clients=cfg.bank_shard_clients,
+        key=key)
+    if not built:
+        print(f"[bank] opened existing {cfg.partitioner} bank "
+              f"({bank.population:,} clients) at {bank.dir}")
+    max_n = bank.padded_max_n(cfg.bs)
+    shard_shim = AgentShards(
+        images=np.zeros((0, max_n) + train.images.shape[1:],
+                        dtype=train.images.dtype),
+        labels=np.zeros((0, max_n), dtype=np.int32),
+        sizes=np.zeros((0,), dtype=np.int32))
+    pv_imgs, pv_lbls = build_poisoned_val(val.images, val.labels, cfg)
+    mean, std = _norm_arrays(cfg.data)
+    return CohortData(
+        train=shard_shim,
+        val_images=val.images, val_labels=val.labels,
+        pval_images=pv_imgs, pval_labels=pv_lbls,
+        mean=mean, std=std,
+        raw_is_normalized=(cfg.data == "fedemnist"),
+        synthetic=synthetic,
+        bank=bank, base_images=train.images, base_labels=train.labels,
+        max_n=max_n, cfg=cfg)
 
 
 # ---------------------------------------------------------------- loaders ---

@@ -47,9 +47,15 @@ def _pallas_applicable(cfg) -> bool:
     which the fused kernel does not take; defense telemetry
     (obs/telemetry.py) likewise needs the explicit lr/aggregate trees, so
     any --telemetry level falls back to the jnp path."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    # cohort-sampled rounds always carry the active mask (duplicate /
+    # churn-absent padding slots must be excluded from aggregation), which
+    # the fused kernel does not take — same fallback as faults/churn
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
             and cfg.noise == 0 and not cfg.diagnostics
             and not cfg.faults_enabled and not cfg.churn_enabled
+            and not compile_cache.is_cohort_mode(cfg)
             and cfg.telemetry == "off")
 
 
@@ -158,10 +164,17 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
             churn as churn_mod)
         mask = churn_active if mask is None else mask & churn_active
+        # the mask always joins aggregation (cohort shortfall padding
+        # rides it too), but Churn/* and churn-shaped Faults/* series
+        # are emitted only when churn is actually configured — a plain
+        # cohort run must not grow series that make it read as a churn
+        # or faults run (its padding already shows in fault_voters
+        # whenever faults are on)
         if draw is not None:
             extras["fault_voters"] = masking.count_f32(mask)
-            extras["churn_away"] = churn_mod.churn_away(churn_active)
-        else:
+            if cfg.churn_enabled:
+                extras["churn_away"] = churn_mod.churn_away(churn_active)
+        elif cfg.churn_enabled:
             extras = churn_mod.churn_only_scalars(churn_active, mask)
     if _pallas_applicable(cfg):   # never taken when faults are configured
         from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
@@ -406,13 +419,20 @@ def make_chained_host(step):
     dispatch + gather per round (the fedemnist-scale path, ref
     src/runner.sh:34-38 at 500 rounds): the driver prefetches a whole
     block's shard stacks and the TPU runs `chain` rounds per dispatch.
-    Shared by the single-device and sharded host paths."""
+    Shared by the single-device and sharded host paths — and by the
+    cohort-sampled steps (data/cohort.py), whose ``takes_round`` signature
+    gets the scanned round index threaded through (the scan already
+    carries it), so a chained cohort block recomputes its per-round
+    cohort ids, corrupt flags and churn mask in-program."""
+    takes_round = getattr(step, "takes_round", False)
+
     @functools.partial(jax.jit, donate_argnums=0)
     def chained(params, base_key, round_ids, imgs, lbls, sizes):
         def body(params, xs):
             rnd, im, lb, sz = xs
+            lead = (rnd,) if takes_round else ()
             new_params, info = step(
-                params, jax.random.fold_in(base_key, rnd), im, lb, sz)
+                params, jax.random.fold_in(base_key, rnd), *lead, im, lb, sz)
             out = {"train_loss": info["train_loss"]}
             out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
             out.update({k: v for k, v in info.items()
@@ -437,3 +457,65 @@ def make_chained_round_fn_host(cfg, model, normalize):
     return make_chained_host(
         make_host_step(cfg.replace(diagnostics=False), model, normalize,
                        take_flags=False))
+
+
+# ------------------------------------------------------- cohort-sampled ---
+
+def make_cohort_step(cfg, model, normalize):
+    """Unjitted cohort-sampled step(params, key, rnd, imgs, lbls, sizes) —
+    the population/cohort-split round body (ISSUE 7).
+
+    Data arrives host-gathered like the host-sampled path (fixed [m, ...]
+    stacks from the client bank, data/bank.py), but the cohort ids are
+    recomputed IN-PROGRAM from the traced round index (data/cohort.py) —
+    the same seeded draw the driver's gather mirrored — so:
+
+    - corrupt flags are real client ids (``ids < num_corrupt``), making
+      Defense/* cosine splits and Faults/* rates functions of cohort
+      MEMBERSHIP (a round that samples no corrupt client reports a zero
+      corrupt electorate, test-pinned);
+    - the churn lifecycle mask composes (cohorts are sampled from the
+      churn-present set — the host-sampled + churn refusal is retired);
+    - the chained scan needs no flag side-channel: flags re-derive from
+      the scanned round index, so chaining survives faults and full
+      telemetry keeps its honest/corrupt split.
+
+    The [m] ``active`` mask (False = duplicate / churn-absent shortfall
+    padding) always joins the participation-mask protocol: padded slots
+    are excluded from aggregation arithmetically, like dropped clients."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        cohort as cohort_mod)
+    local_train = make_local_train(model, cfg, normalize)
+    want_flags = host_takes_flags(cfg)
+
+    def step(params, key, rnd, imgs, lbls, sizes):
+        with jax.named_scope("cohort_sample"):
+            ids, active = cohort_mod.sample_cohort(cfg, rnd)
+        k_train, k_noise = jax.random.split(key)
+        new_params, train_loss, extras = _round_core(
+            params, k_train, k_noise, imgs, lbls, sizes,
+            local_train=local_train, cfg=cfg,
+            corrupt_flags=((ids < cfg.num_corrupt) & active
+                           if want_flags else None),
+            churn_active=active)
+        return new_params, {"train_loss": train_loss, "sampled": ids,
+                            **extras}
+
+    step.takes_round = True
+    return step
+
+
+def make_cohort_round_fn(cfg, model, normalize):
+    """Cohort-sampled round fn: round(params, key, rnd, imgs, lbls, sizes).
+    The driver mirrors the in-program draw (data/cohort.sample_cohort) to
+    gather the cohort's bank rows; one compilation serves every round."""
+    return jax.jit(make_cohort_step(cfg, model, normalize))
+
+
+def make_chained_cohort_round_fn(cfg, model, normalize):
+    """Chained cohort rounds: chained(params, base_key, round_ids, imgs,
+    lbls, sizes) over [chain, m, ...] bank-row blocks. Unlike the plain
+    host chain, faults and the full-telemetry cosine split survive
+    chaining — the scanned round index re-derives the flags in-program."""
+    return make_chained_host(
+        make_cohort_step(cfg.replace(diagnostics=False), model, normalize))

@@ -384,7 +384,26 @@ def parse_top_ops(trace_dir: str, top: int, rounds: int,
 MEMORY_TAGS = {
     "hbm_live_bytes": "Memory/HBM_Live_Bytes",
     "hbm_peak_bytes": "Memory/HBM_Peak_Bytes",
+    "host_peak_rss_bytes": "Memory/Host_Peak_RSS_Bytes",
 }
+
+
+def host_watermarks() -> Dict[str, int]:
+    """Peak host RSS of this process (stdlib getrusage; ru_maxrss is KiB
+    on Linux, bytes on macOS) — the population-axis memory judge: the
+    constant-memory claim (ISSUE 7) pins this flat across a
+    10k -> 100k -> 1M client ladder. Kept separate from
+    ``memory_watermarks`` (device allocator stats) so backends without
+    memory_stats still report host pressure."""
+    try:
+        import resource
+        import sys
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        if sys.platform != "darwin":
+            rss *= 1024
+        return {"host_peak_rss_bytes": int(rss)}
+    except Exception:
+        return {}
 
 
 def memory_rows(mem: Dict[str, int]) -> List[Tuple[str, float]]:

@@ -306,7 +306,8 @@ def _sharded_pallas_apply(params, updates, sizes, cfg):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
-def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
+def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
+                        take_active=None):
     """The shard_mapped round body shared by the per-round and chained fns.
 
     With faults — or full telemetry — configured the body takes a trailing
@@ -317,11 +318,17 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
     (faults/model.py — no collective needed to agree on who failed),
     slices its local block of the draw by mesh position, and the only
     added communication is one tiny all_gather of the per-device
-    payload-validation bits."""
+    payload-validation bits.
+
+    `take_active` adds the trailing replicated [m] bool availability mask
+    input (default: on iff churn is configured). The cohort-sampled
+    builders force it on — their active mask (shortfall padding) rides
+    the same input whether or not churn is configured — still with ZERO
+    added collectives (the mask arrives replicated)."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         _pallas_applicable, host_takes_flags)
     faults_on = cfg.faults_enabled
-    churn_on = cfg.churn_enabled
+    churn_on = cfg.churn_enabled if take_active is None else take_active
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     if faults_on:
@@ -409,9 +416,12 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
         extras = {}
         if faults_on:
             extras.update(fmodel.fault_scalars(draw, mask_full))
-            if churn_full is not None:
+            if churn_full is not None and cfg.churn_enabled:
                 extras["churn_away"] = churn_mod.churn_away(churn_full)
-        elif churn_full is not None:
+        elif churn_full is not None and cfg.churn_enabled:
+            # emission gated on churn actually being configured: the
+            # cohort builders force the active INPUT on (shortfall
+            # padding joins the mask) without growing churn series
             extras.update(churn_mod.churn_only_scalars(churn_full,
                                                        mask_full))
         if cfg.telemetry != "off":
@@ -436,11 +446,11 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None):
         return new_params, loss, extras
 
     extras_specs = {}
-    if faults_on or churn_on:
+    if faults_on or (churn_on and cfg.churn_enabled):
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
             FAULT_INFO_KEYS)
         extras_specs.update({k: P() for k in FAULT_INFO_KEYS})
-    if churn_on:
+    if churn_on and cfg.churn_enabled:
         extras_specs["churn_away"] = P()
     if cfg.telemetry != "off":
         from defending_against_backdoors_with_robust_learning_rate_tpu.obs.telemetry import (
@@ -587,6 +597,59 @@ def make_sharded_chained_round_fn_host(cfg, model, normalize, mesh):
     return make_chained_host(
         make_sharded_host_step(cfg.replace(diagnostics=False), model,
                                normalize, mesh, take_flags=False))
+
+
+# ----------------------------------------------------------- cohort path ---
+
+def make_sharded_cohort_step(cfg, model, normalize, mesh):
+    """Unjitted sharded cohort step(params, key, rnd, imgs, lbls, szs):
+    the cohort-sampled round (fl/rounds.make_cohort_step) over the agents
+    mesh. The seeded cohort draw runs OUTSIDE shard_map (replicated — it
+    needs no per-shard data) and its ids/active/corrupt-flags enter the
+    body as replicated [m] inputs, so the whole population/cohort split
+    adds ZERO collectives to the documented communication plan (pinned by
+    the *_cohort specs in analysis/contracts.py)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        cohort as cohort_mod)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        host_takes_flags)
+    want_flags = host_takes_flags(cfg)
+    sharded = _build_sharded_body(cfg, model, normalize, mesh,
+                                  take_flags=want_flags, take_active=True)
+    m = cfg.agents_per_round
+
+    def step(params, key, rnd, imgs, lbls, szs):
+        with jax.named_scope("cohort_sample"):
+            ids, active = cohort_mod.sample_cohort(cfg, rnd)
+        k_train, k_noise = jax.random.split(key)
+        agent_keys = jax.random.split(k_train, m)
+        extra = (((ids < cfg.num_corrupt) & active,) if want_flags else ())
+        extra = extra + (active,)
+        new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
+                                                 agent_keys, k_noise, *extra)
+        return new_params, {"train_loss": train_loss, "sampled": ids,
+                            **extras}
+
+    step.takes_round = True
+    return step
+
+
+def make_sharded_cohort_round_fn(cfg, model, normalize, mesh):
+    """Sharded cohort round fn: round(params, key, rnd, imgs, lbls, szs) —
+    the bank-gathered [m, ...] cohort stacks partitioned over the agents
+    mesh (m/d per device), cohort ids recomputed in-program."""
+    return jax.jit(make_sharded_cohort_step(cfg, model, normalize, mesh))
+
+
+def make_sharded_chained_cohort_round_fn(cfg, model, normalize, mesh):
+    """Chained sharded cohort rounds over [chain, m, ...] blocks sharded on
+    the m axis; the scanned round index re-derives each round's cohort
+    ids, flags and churn mask in-program (fl/rounds.make_chained_host)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_host)
+    return make_chained_host(
+        make_sharded_cohort_step(cfg.replace(diagnostics=False), model,
+                                 normalize, mesh))
 
 
 def make_sharded_chained_round_fn(cfg, model, normalize, mesh,

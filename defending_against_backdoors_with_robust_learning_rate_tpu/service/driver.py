@@ -7,8 +7,9 @@ frames exactly this simulator-as-service gap):
 
 - **rounds stream** until ``--service_rounds`` is reached, or — with 0 —
   until ``<log_dir>/service.stop`` appears; the client population churns
-  underneath via service/churn.py (device-resident paths; the engine
-  refuses churn + host-sampled).
+  underneath via service/churn.py on every path (a host-sampled run
+  under churn routes through the cohort program, sampling cohorts from
+  the churn-present set — data/cohort.py).
 - **every unit is supervised** (service/supervisor.py): dispatch, eval and
   checkpoint each run under deadline + exponential-backoff retry with
   failure classification. Degradation policy on exhausted retries:
@@ -59,6 +60,11 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics imp
     MetricsWriter, NullWriter, run_name)
 
 STOP_FILE = "service.stop"
+
+# the churn population census (churn.active_count) is an O(population)
+# host-side draw — observability, never worth O(1M) work per boundary on
+# the cohort-sampled population axis
+CENSUS_MAX_POPULATION = 100_000
 
 
 def _metrics_path(cfg: Config) -> str:
@@ -166,10 +172,15 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         print(f"[service] recovered at round {eng.start_round} "
               f"in {time.perf_counter() - t_start:.2f}s")
     stop_path = os.path.join(cfg.log_dir, STOP_FILE)
-    if cfg.churn_enabled:
+    census = cfg.churn_enabled and cfg.num_agents <= CENSUS_MAX_POPULATION
+    if census:
         print(f"[service] population census at start: "
               f"{churn_mod.active_count(cfg, eng.start_round)}/"
               f"{cfg.num_agents} clients active")
+    elif cfg.churn_enabled:
+        print(f"[service] population census skipped "
+              f"({cfg.num_agents:,} clients > {CENSUS_MAX_POPULATION:,}; "
+              f"O(population) draw)")
 
     def unit_stream():
         rnd = eng.start_round
@@ -262,7 +273,7 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                     else:
                         raise
                 chaos.corrupt_checkpoint(cfg.checkpoint_dir, rnd)
-                if lead and cfg.churn_enabled:
+                if lead and census:
                     eng.writer.scalar(
                         "Service/Active_Clients",
                         churn_mod.active_count(cfg, rnd), rnd)

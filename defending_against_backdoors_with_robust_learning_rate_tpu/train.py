@@ -190,7 +190,28 @@ class RoundEngine:
                   f"{compile_cache.cache_root(cfg)}"
                   + ("" if bank is not None
                      else " (AOT bank off: --debug_nan)"))
-        fed = get_federated_data(cfg)
+        # population/cohort split (ISSUE 7): the cfg-only decision comes
+        # FIRST — a million-client population must never be materialized
+        # densely just to decide not to materialize it. The client bank
+        # (data/bank.py) holds the population offset-indexed on disk;
+        # `fed` then carries a zero-client shape shim plus the eval sets.
+        cohort_mode = compile_cache.is_cohort_mode(cfg)
+        cohort_src = None
+        if (not cohort_mode and cfg.cohort_sampled == "auto"
+                and cfg.num_agents
+                >= compile_cache.COHORT_AUTO_MIN_POPULATION):
+            print(f"[cohort] population {cfg.num_agents:,} is above the "
+                  f"auto threshold but the implied cohort of "
+                  f"{cfg.agents_per_round} cannot be sampled "
+                  f"(data/cohort.py MAX_CANDIDATES); staying on the dense "
+                  f"path — set --cohort_size to decouple population from "
+                  f"cohort")
+        if cohort_mode:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+                get_cohort_data)
+            cohort_src = fed = get_cohort_data(cfg)
+        else:
+            fed = get_federated_data(cfg)
         if fed.synthetic and cfg.data != "synthetic":
             print(f"[data] {cfg.data} files not found under "
                   f"{cfg.data_dir!r}; using the deterministic synthetic "
@@ -208,10 +229,36 @@ class RoundEngine:
         # (compile_cache.is_host_mode) so banked families always match what
         # this loop dispatches; the threshold stays the module global for
         # test monkeypatching
-        host_mode = compile_cache.is_host_mode(
+        host_mode = (not cohort_mode) and compile_cache.is_host_mode(
             cfg, fed, threshold=DEVICE_RESIDENT_BYTES)
+        if host_mode and cfg.churn_enabled:
+            # churn-aware cohorting (ROADMAP carry-over from PR 6): a
+            # host-sampled run under churn routes through the cohort
+            # program — cohorts sampled in-program from the churn-present
+            # set over the dense host stacks — instead of the old loud
+            # refusal. The decision defers to is_cohort_mode (the same
+            # single source the planner and precompile consult), which
+            # honors an explicit --cohort_sampled off AND requires the
+            # implied cohort to be samplable; either way the refusal
+            # stays loud rather than crashing mid-construction.
+            if compile_cache.is_cohort_mode(
+                    cfg, fed, threshold=DEVICE_RESIDENT_BYTES):
+                cohort_mode, host_mode = True, False
+                print("[cohort] host-sampled + churn: cohorts are "
+                      "sampled from the churn-present set (the refusal "
+                      "path is retired)")
+            else:
+                raise ValueError(
+                    "host-sampled + churn needs the cohort program "
+                    "(cohorts sampled from the churn-present set), but "
+                    "this config cannot take it: --cohort_sampled is "
+                    "'off', or the implied cohort of "
+                    f"{cfg.agents_per_round} clients is not samplable "
+                    "(data/cohort.py MAX_CANDIDATES) — set "
+                    "--cohort_size, raise --churn_available, or disable "
+                    "churn")
         n_mesh = 1
-        if cfg.mesh != 1 and not host_mode:
+        if cfg.mesh != 1 and not host_mode and not cohort_mode:
             from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
                 make_mesh, pick_agent_mesh_size)
             from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
@@ -267,6 +314,133 @@ class RoundEngine:
                     make_sharded_chained_round_fn)
                 chained_fn = make_sharded_chained_round_fn(
                     plain_cfg, model, norm, mesh, *arrays)
+        elif cohort_mode:
+            # ----------------------------------------------- cohort mode
+            # population decoupled from cohort (ISSUE 7): the driver
+            # mirrors the seeded in-program cohort draw (data/cohort.py)
+            # to gather only the m sampled clients' rows — from the
+            # memory-mapped client bank, or (churn-aware host mode) from
+            # the dense host stacks — and the round program recomputes
+            # the same ids from the traced round index to derive corrupt
+            # and churn flags per cohort MEMBER. Host/HBM stay O(cohort).
+            m = cfg.agents_per_round
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "cohort-sampled mode is single-process for now — the "
+                    "pod-scale aggregation rework (ROADMAP) will shard "
+                    "the cohort gather across hosts")
+            if cohort_src is not None:
+                print(f"[cohort] population {cfg.num_agents:,} clients -> "
+                      f"{m}-client cohorts ({cfg.partitioner} client "
+                      f"bank, {cohort_src.max_n} rows/cohort member; "
+                      f"in-program sampling, cohort_seed "
+                      f"{cfg.cohort_seed})")
+                gather_rows = cohort_src.gather_cohort
+            else:
+                print(f"[cohort] {cfg.num_agents} clients -> {m}-client "
+                      f"cohorts sampled from the churn-present set over "
+                      f"the host shard stacks")
+
+                def gather_rows(ids):
+                    return (fed.train.images[ids], fed.train.labels[ids],
+                            fed.train.sizes[ids])
+            take = lambda a: jnp.asarray(a)  # noqa: E731
+            take_block = take
+            round_fn_host = None
+            if cfg.mesh != 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+                    AGENTS_AXIS, make_mesh, pick_agent_mesh_size)
+                from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                    make_sharded_cohort_round_fn)
+                n_mesh = pick_agent_mesh_size(cfg.mesh, m)
+                if n_mesh > 1:
+                    mesh = make_mesh(n_mesh)
+                    print(f"[mesh] {n_mesh} devices on the `agents` axis "
+                          f"({m // n_mesh} cohort members/device), "
+                          f"cohort-sampled")
+                    agents_sharding = NamedSharding(mesh, P(AGENTS_AXIS))
+                    block_sharding = NamedSharding(mesh,
+                                                   P(None, AGENTS_AXIS))
+                    take = lambda a: jax.device_put(  # noqa: E731
+                        a, agents_sharding)
+                    take_block = lambda a: jax.device_put(  # noqa: E731
+                        a, block_sharding)
+                    round_fn_host = make_sharded_cohort_round_fn(
+                        plain_cfg, model, norm, mesh)
+                    diag_round_fn_host = (
+                        make_sharded_cohort_round_fn(cfg, model, norm,
+                                                     mesh)
+                        if cfg.diagnostics else round_fn_host)
+                else:
+                    print(f"[mesh] no device count <= {cfg.mesh or 'all'} "
+                          f"divides the cohort of {m}; --mesh request "
+                          f"ignored")
+            if round_fn_host is None:
+                from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                    make_cohort_round_fn)
+                round_fn_host = make_cohort_round_fn(plain_cfg, model, norm)
+                diag_round_fn_host = (
+                    make_cohort_round_fn(cfg, model, norm)
+                    if cfg.diagnostics else round_fn_host)
+            if chain_n > 1:
+                # cohort chaining survives faults AND keeps the full-
+                # telemetry cosine split: the scanned round index
+                # re-derives flags in-program (fl/rounds.make_cohort_step)
+                if n_mesh > 1:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+                        make_sharded_chained_cohort_round_fn)
+                    host_chained_fn = make_sharded_chained_cohort_round_fn(
+                        plain_cfg, model, norm, mesh)
+                else:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+                        make_chained_cohort_round_fn)
+                    host_chained_fn = make_chained_cohort_round_fn(
+                        plain_cfg, model, norm)
+
+            from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+                cohort as cohort_mod)
+
+            def sample_ids(rnd):
+                # the host mirror of the in-program draw — bit-identical
+                # ids (data/cohort.py), evaluated on the prefetch thread.
+                # static: ok(host-sync)
+                ids, _active = cohort_mod.sample_cohort_host(cfg, rnd)
+                return ids
+
+            def gather_unit(unit):
+                """One dispatch unit's cohort payload: a single round's
+                [m, ...] stacks or a chained block's [chain, m, ...]
+                stacks — O(cohort) gather riding the prefetch thread, so
+                bank reads + H2D overlap the running round program."""
+                with tracer.span("prefetch/gather", rounds=len(unit)):
+                    ids = np.stack([sample_ids(r) for r in unit])
+                    if len(unit) == 1:
+                        imgs, lbls, szs = gather_rows(ids[0])
+                        return (ids[0], take(imgs), take(lbls), take(szs))
+                    rows = [gather_rows(i) for i in ids]
+                    return (ids,
+                            take_block(np.stack([r[0] for r in rows])),
+                            take_block(np.stack([r[1] for r in rows])),
+                            take_block(np.stack([r[2] for r in rows])))
+
+            if cfg.host_prefetch > 0:
+                print(f"[prefetch] cohort gather pipeline, depth "
+                      f"{cfg.host_prefetch}")
+            get_unit = self._unit_fetcher(gather_unit)
+
+            def host_sampler(params, key, rnd, want_diag):
+                with tracer.span("round/data_prep", round=rnd):
+                    _ids, imgs, lbls, szs = get_unit((rnd,))
+                fn = diag_round_fn_host if want_diag else round_fn_host
+                with tracer.span("round/dispatch", round=rnd):
+                    # the round index is a traced int32 lead argument —
+                    # the program recomputes the cohort (ids, flags,
+                    # churn mask) from it; `sampled` in the info dict is
+                    # the program's own draw
+                    new_params, info = fn(params, key, jnp.int32(rnd),
+                                          imgs, lbls, szs)
+                return new_params, info
         elif host_mode:
             print(f"[data] host-sampled mode "
                   f"({fed.train.images.nbytes / 2**30:.1f} GiB of shards)")
@@ -400,20 +574,7 @@ class RoundEngine:
                 print(f"[prefetch] host->device pipeline, depth "
                       f"{cfg.host_prefetch}")
 
-            def get_unit(unit):
-                if cfg.host_prefetch > 0:
-                    if self._prefetcher is None:
-                        # _sched_units is THE loop's schedule (set before
-                        # the loop starts; the first get_unit call is its
-                        # first entry), so production order provably
-                        # matches consumption order
-                        from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
-                            RoundPrefetcher)
-                        self._prefetcher = RoundPrefetcher(
-                            gather_unit, self._sched_units,
-                            depth=cfg.host_prefetch)
-                    return self._prefetcher.get(unit)
-                return gather_unit(unit)
+            get_unit = self._unit_fetcher(gather_unit)
 
             def host_sampler(params, key, rnd, want_diag):
                 with tracer.span("round/data_prep", round=rnd):
@@ -563,34 +724,50 @@ class RoundEngine:
             # scalar (service/churn.py; single source with plan_programs)
             lead_avals = ((jax.ShapeDtypeStruct((), jnp.int32),)
                           if cfg.churn_enabled else ())
-            if host_sampler is not None:
+            if cohort_mode or host_sampler is not None:
+                # one adoption triad (round / diag / chained block) for
+                # both [m, ...]-stack branches; they differ only in
+                # family names and the per-round signature — cohort
+                # takes the traced round index as a lead int32 and no
+                # flag avals (flags derive in-program from the
+                # recomputed cohort ids), host takes trailing corrupt
+                # flags when faults/full telemetry need them
                 m = cfg.agents_per_round
                 shard_avals = tuple(
                     jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
                     for a in (fed.train.images, fed.train.labels,
                               fed.train.sizes))
-                flag_avals = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
-                              if host_takes_flags(cfg) else ())
+                if cohort_mode:
+                    fams = ("round_cohort", "round_cohort_diag",
+                            "chained_cohort")
+                    round_avals = (
+                        (p_aval, k_aval,
+                         jax.ShapeDtypeStruct((), jnp.int32))
+                        + shard_avals)
+                else:
+                    fams = ("round_host", "round_host_diag",
+                            "chained_host")
+                    flag_avals = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
+                                  if host_takes_flags(cfg) else ())
+                    round_avals = ((p_aval, k_aval) + shard_avals
+                                   + flag_avals)
                 shared = diag_round_fn_host is round_fn_host
-                fn = _adopt_aot(bank, cfg, "round_host", round_fn_host,
-                                (p_aval, k_aval) + shard_avals + flag_avals)
+                fn = _adopt_aot(bank, cfg, fams[0], round_fn_host,
+                                round_avals)
                 if fn is not None:
                     round_fn_host = fn
                     if shared:
                         diag_round_fn_host = fn
                 if cfg.diagnostics:
-                    fn = _adopt_aot(bank, cfg, "round_host_diag",
-                                    diag_round_fn_host,
-                                    (p_aval, k_aval) + shard_avals
-                                    + flag_avals)
+                    fn = _adopt_aot(bank, cfg, fams[1],
+                                    diag_round_fn_host, round_avals)
                     if fn is not None:
                         diag_round_fn_host = fn
                 if host_chained_fn is not None:
                     block_avals = tuple(
                         jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
                         for a in shard_avals)
-                    fn = _adopt_aot(bank, cfg, "chained_host",
-                                    host_chained_fn,
+                    fn = _adopt_aot(bank, cfg, fams[2], host_chained_fn,
                                     (p_aval, k_aval, ids_aval)
                                     + block_avals)
                     if fn is not None:
@@ -672,6 +849,7 @@ class RoundEngine:
         self.chain_n = chain_n
         self.n_mesh = n_mesh
         self.host_mode = host_mode
+        self.cohort_mode = cohort_mode
         self.val, self.pval = val, pval
         self._round_fn, self._diag_round_fn = (
             (round_fn, diag_round_fn) if host_sampler is None
@@ -778,6 +956,28 @@ class RoundEngine:
             self.prof.after_unit(self.params, len(unit))
         if self._want_diag:
             self._emit_diagnostics(info)
+
+    def _unit_fetcher(self, gather_unit):
+        """The payload-fetch closure shared by the host-sampled and
+        cohort-sampled branches: direct gather, or the depth-bounded
+        prefetch pipeline (data/prefetch.py) created lazily at the first
+        dispatch. _sched_units is THE loop's schedule (set before the
+        loop starts; the first get_unit call is its first entry), so
+        production order provably matches consumption order."""
+        cfg = self.cfg
+
+        def get_unit(unit):
+            if cfg.host_prefetch > 0:
+                if self._prefetcher is None:
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.data.prefetch import (
+                        RoundPrefetcher)
+                    self._prefetcher = RoundPrefetcher(
+                        gather_unit, self._sched_units,
+                        depth=cfg.host_prefetch)
+                return self._prefetcher.get(unit)
+            return gather_unit(unit)
+
+        return get_unit
 
     def _get_unit(self, unit):
         if self._get_unit_impl is None:
@@ -1034,6 +1234,9 @@ class RoundEngine:
         # --profile_rounds=0 and the backend exposes no memory_stats — the
         # off path emits nothing.
         mem = obs_attribution.memory_watermarks()
+        # host RSS rides the same Memory/* rows: the population-axis CI
+        # job pins it flat across the client-population ladder (ISSUE 7)
+        mem.update(obs_attribution.host_watermarks())
         if self.prof is not None:
             for key, val in self.prof.mem.items():
                 mem[key] = max(mem.get(key, 0), val)

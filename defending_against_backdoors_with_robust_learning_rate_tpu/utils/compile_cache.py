@@ -26,6 +26,9 @@ Program families (the manifest vocabulary; see `plan_programs`):
     chained                 device-resident lax.scan round block
     round_host[_diag]       host-sampled per-round fn
     chained_host            host-sampled chained block
+    round_cohort[_diag] /   cohort-sampled population path (ISSUE 7):
+    chained_cohort /        in-program seeded cohort over the client
+    round_sharded_cohort    bank (data/bank.py + data/cohort.py)
     round_sharded /         shard_map variants (parallel/rounds.py) —
     chained_sharded         adopted at runtime, banked best-effort
     eval_val / eval_poison  the two eval-set program instances
@@ -89,6 +92,12 @@ EXCLUDED_FIELDS = frozenset({
     # fingerprinted)
     "service_rounds", "service_retries", "service_backoff_s",
     "service_deadline_s", "service_keep_ckpts", "chaos",
+    # population axis (ISSUE 7): `cohort_sampled` selects the cohort
+    # program families (names key the fingerprint, like host_sampled);
+    # bank storage location / IO shard layout never shape a program
+    # (cohort_seed/cohort_size and the partitioner fields by contrast DO
+    # shape programs or data and are fingerprinted)
+    "cohort_sampled", "bank_dir", "bank_shard_clients",
 })
 
 # families built from cfg.replace(diagnostics=False) in the driver; their
@@ -337,13 +346,15 @@ def setup(cfg):
     return AotBank(root)
 
 
-def chain_budget(cfg, host_mode: bool = False) -> int:
+def chain_budget(cfg, host_mode: bool = False, cohort: bool = False) -> int:
     """Rounds fused per dispatch — the driver's exact budget: capped at
     `snap` (minus the unchained diagnostic snap round), and 1 in
     host-sampled mode under faults (per-round corrupt flags ride each
-    dispatch; train.py prints the reason)."""
+    dispatch; train.py prints the reason). Cohort-sampled mode keeps its
+    chain under faults: the scanned round index re-derives the flags
+    in-program (fl/rounds.make_cohort_step)."""
     n = max(1, min(cfg.chain, cfg.snap - (1 if cfg.diagnostics else 0)))
-    if host_mode and cfg.faults_enabled:
+    if host_mode and cfg.faults_enabled and not cohort:
         return 1
     return n
 
@@ -358,6 +369,50 @@ def is_host_mode(cfg, fed, threshold: Optional[int] = None) -> bool:
     return (cfg.host_sampled == "on"
             or (cfg.host_sampled == "auto"
                 and fed.train.images.nbytes > threshold))
+
+
+# populations at or above this auto-select the cohort-sampled path: a
+# dense [K, max_n, ...] stack at 4096+ clients is already the wrong
+# layout, and the paper-scale configs (K <= 40, fedemnist 3383) stay on
+# their historical bit-exact paths
+COHORT_AUTO_MIN_POPULATION = 4096
+
+
+def is_cohort_mode(cfg, fed=None, threshold: Optional[int] = None) -> bool:
+    """Single source of the driver's cohort-sampled decision (ISSUE 7) —
+    train.run, the precompile planner and the jaxpr contracts must agree
+    on which program families a config dispatches.
+
+    Without `fed` this is the cfg-only decision (explicit on/off, or the
+    auto population threshold) — callable before any data is built, which
+    is the point: a 1M-client population must never be materialized
+    densely just to decide not to materialize it. With `fed`, a
+    host-sampled run under churn ALSO routes to the cohort program
+    (cohorts sampled in-program from the churn-present set over the dense
+    host stacks) — retiring the host-sampled + churn refusal."""
+    if cfg.cohort_sampled == "on":
+        return True
+    if cfg.cohort_sampled == "off":
+        return False
+    if cfg.num_agents >= COHORT_AUTO_MIN_POPULATION:
+        # auto additionally requires the implied cohort to be samplable:
+        # with --cohort_size unset, m = floor(K * agent_frac) can be
+        # population-sized, and auto-routing such a config into the
+        # cohort sampler would CRASH a previously-working dense run
+        # (oversample > MAX_CANDIDATES). Infeasible => stay dense, with
+        # a hint printed by the engine; an explicit `on` stays loud.
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            cohort as cohort_mod)
+        return cohort_mod.cohort_feasible(cfg)
+    if fed is not None and cfg.churn_enabled \
+            and is_host_mode(cfg, fed, threshold):
+        # churn-aware cohorting for host-sampled runs — only when the
+        # cohort is actually samplable; the driver refuses loudly
+        # otherwise (the PR-6 behavior)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            cohort as cohort_mod)
+        return cohort_mod.cohort_feasible(cfg)
+    return False
 
 
 @dataclasses.dataclass
@@ -379,26 +434,53 @@ def plan_programs(cfg, model, norm, fed,
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
         make_eval_fn, pad_eval_set)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-        host_takes_flags, make_chained_round_fn, make_chained_round_fn_host,
-        make_round_fn, make_round_fn_host)
+        host_takes_flags, make_chained_cohort_round_fn,
+        make_chained_round_fn, make_chained_round_fn_host,
+        make_cohort_round_fn, make_round_fn, make_round_fn_host)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         init_params)
 
+    cohort_mode = is_cohort_mode(cfg, fed)
     if host_mode is None:
-        host_mode = is_host_mode(cfg, fed)
+        host_mode = (not cohort_mode) and is_host_mode(cfg, fed)
     image_shape = fed.train.images.shape[2:]
     params_aval = jax.eval_shape(
         lambda k: init_params(model, image_shape, k), jax.random.PRNGKey(0))
     key_aval = abstractify(jax.random.PRNGKey(0))
     data_avals = abstractify((fed.train.images, fed.train.labels,
                               fed.train.sizes))
-    chain_n = chain_budget(cfg, host_mode)
+    chain_n = chain_budget(cfg, host_mode, cohort=cohort_mode)
     ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
     plain = cfg.replace(diagnostics=False)
     m = cfg.agents_per_round
     specs: List[ProgramSpec] = []
 
-    if host_mode:
+    if cohort_mode:
+        # cohort-sampled families (ISSUE 7): data arrives as [m, ...]
+        # cohort stacks like host mode, plus the traced round index the
+        # in-program sampling consumes (data/cohort.py) — no flag
+        # arguments, the program derives them from real client ids
+        rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        shard_avals = tuple(
+            jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
+            for a in data_avals)
+        specs.append(ProgramSpec(
+            "round_cohort", make_cohort_round_fn(plain, model, norm),
+            (params_aval, key_aval, rnd_aval) + shard_avals))
+        if cfg.diagnostics:
+            specs.append(ProgramSpec(
+                "round_cohort_diag",
+                make_cohort_round_fn(cfg, model, norm),
+                (params_aval, key_aval, rnd_aval) + shard_avals))
+        if chain_n > 1:
+            block_avals = tuple(
+                jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
+                for a in shard_avals)
+            specs.append(ProgramSpec(
+                "chained_cohort",
+                make_chained_cohort_round_fn(plain, model, norm),
+                (params_aval, key_aval, ids_aval) + block_avals))
+    elif host_mode:
         shard_avals = tuple(
             jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
             for a in data_avals)
@@ -461,8 +543,8 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
     the lowering hook that keeps the analysis surface and the dispatch
     surface from drifting."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
-        make_sharded_chained_round_fn, make_sharded_round_fn,
-        make_sharded_round_fn_host)
+        make_sharded_chained_round_fn, make_sharded_cohort_round_fn,
+        make_sharded_round_fn, make_sharded_round_fn_host)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         host_takes_flags)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
@@ -474,10 +556,21 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
     key_aval = abstractify(jax.random.PRNGKey(0))
     data_avals = abstractify((fed.train.images, fed.train.labels,
                               fed.train.sizes))
-    chain_n = chain_budget(cfg, host_mode)
+    chain_n = chain_budget(cfg, host_mode,
+                           cohort=is_cohort_mode(cfg, fed))
     plain = cfg.replace(diagnostics=False)
     m = cfg.agents_per_round
     specs: List[ProgramSpec] = []
+    if is_cohort_mode(cfg, fed):
+        shard_avals = tuple(
+            jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
+            for a in data_avals)
+        rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        specs.append(ProgramSpec(
+            "round_sharded_cohort",
+            make_sharded_cohort_round_fn(plain, model, norm, mesh),
+            (params_aval, key_aval, rnd_aval) + shard_avals))
+        return specs
     if host_mode:
         shard_avals = tuple(
             jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)

@@ -45,11 +45,32 @@ def run_name(cfg) -> str:
         # in the churn process must not share a run dir
         churn = (f"-chrn:a{cfg.churn_available}p{cfg.churn_period}"
                  f"s{cfg.churn_seed}")
+    cohort = ""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    if compile_cache.is_cohort_mode(cfg) or cfg.churn_enabled:
+        # population-axis cells (ISSUE 7): two runs differing only in
+        # population / cohort size / partitioner must not share a run
+        # dir. Churn runs get the cell too: a host-sampled run under
+        # churn reroutes to the cohort program at engine construction
+        # (train.py — a data-size decision run_name cannot see), and its
+        # results then depend on cohort_seed/cohort_size.
+        part = cfg.partitioner
+        # the partition-shaping params ride the cell too — two runs
+        # differing only in the bank's content must not share a dir
+        if part == "dirichlet":
+            part += f":a{cfg.dirichlet_alpha}n{cfg.samples_per_client}"
+        elif part == "pathological":
+            part += (f":c{cfg.classes_per_client}"
+                     f"n{cfg.samples_per_client}")
+        cohort = (f"-coh:K{cfg.num_agents}m{cfg.agents_per_round}"
+                  f"-{part}-cs{cfg.cohort_seed}")
     return (f"clip_val:{cfg.clip}"
             f"-noise_std:{cfg.noise}-aggr:{cfg.aggr}"
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
-            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}{faults}{churn}")
+            f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}"
+            f"{faults}{churn}{cohort}")
 
 
 class NullWriter:

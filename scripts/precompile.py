@@ -88,7 +88,16 @@ def main():
                 synth_train_size=args.synth_train_size,
                 synth_val_size=max(512, args.synth_train_size // 10),
                 data_dir="/nonexistent_use_synthetic_reduced")
-        fed = get_federated_data(cfg)
+        # cohort-mode configs must NOT be materialized densely (the point
+        # of the population axis) — and their shard avals come from the
+        # bank's padded row length, not the dense stack's, so the banked
+        # executables match what train.py dispatches
+        if compile_cache.is_cohort_mode(cfg):
+            from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+                get_cohort_data)
+            fed = get_cohort_data(cfg)
+        else:
+            fed = get_federated_data(cfg)
         model = get_model(cfg.data, cfg.model_arch, cfg.dtype,
                           remat=cfg.remat, remat_policy=cfg.remat_policy)
         norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)

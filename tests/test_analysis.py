@@ -297,7 +297,10 @@ def test_audit_catches_runtime_tag_on_program_read_field():
 def test_property_reads_map_to_fields():
     cfg_path = os.path.join(REPO, contracts.PKG, "config.py")
     props = fingerprint_audit.property_field_map(cfg_path)
-    assert props["agents_per_round"] == {"num_agents", "agent_frac"}
+    # cohort_size joined in ISSUE 7: an explicit cohort size overrides
+    # the legacy floor(K * C) product
+    assert props["agents_per_round"] == {"num_agents", "agent_frac",
+                                         "cohort_size"}
     assert "dropout_rate" in props["faults_enabled"]
     reads = fingerprint_audit.program_field_reads(REPO)
     # fl/rounds reads cfg.agents_per_round -> both underlying fields seen

@@ -1,0 +1,520 @@
+"""Population/cohort decoupling (ISSUE 7).
+
+Covers the three layers of the million-client axis:
+
+- **client bank** (data/bank.py): offset-indexed sharded store, scaling
+  partitioners (dirichlet / pathological), bitwise label_shards parity
+  with the dense stacked layout, IO-layout independence, and
+  cross-process fingerprint stability at 100k clients;
+- **cohort sampling** (data/cohort.py): in-program seeded draw, host
+  mirror bit-identity, dedup/shortfall/churn-eligibility semantics;
+- **cohort round programs + bookkeeping**: the program's own draw equals
+  the host mirror, Defense/* cosine splits and Faults/* rates are
+  functions of cohort MEMBERSHIP (pinned on a round that samples no
+  corrupt client), the churn + host-sampled refusal is retired, and the
+  host-RSS ladder stays flat in population size.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu import train
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+    FIELD_PROVENANCE, Config)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+    bank as bank_mod, cohort as cohort_mod, native)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
+    stack_agent_shards)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+    get_cohort_data)
+from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+    churn as churn_mod)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.compile_cache import (
+    is_cohort_mode)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+    NullWriter, run_name)
+
+
+def _labels(n=2000, seed=0, n_classes=10):
+    return np.random.default_rng(seed).integers(
+        0, n_classes, size=n).astype(np.int64)
+
+
+# ------------------------------------------------------------- bank ------
+
+def test_label_shards_bank_matches_dense_stack(tmp_path):
+    """A label_shards bank row is bitwise the dense stacked row: same
+    partitioner, same padding rule, gathered through the offset store."""
+    labels = _labels(400)
+    rng = np.random.default_rng(1)
+    images = rng.random((400, 8, 8, 1)).astype(np.float32)
+    K = 5
+    groups = native.distribute_data(labels, K, n_classes=10)
+    dense = stack_agent_shards(images, labels.astype(np.int32), groups, K,
+                               pad_multiple=4)
+    bank = bank_mod.build_bank(
+        str(tmp_path / "b"), labels, population=K,
+        partitioner="label_shards", log=lambda *_: None)
+    max_n = bank.padded_max_n(4)
+    assert max_n == dense.max_n
+    imgs, lbls, sizes = bank.gather(np.arange(K), images,
+                                    labels.astype(np.int32), max_n)
+    np.testing.assert_array_equal(sizes, dense.sizes)
+    np.testing.assert_array_equal(lbls, dense.labels)
+    np.testing.assert_array_equal(imgs, dense.images)
+
+
+@pytest.mark.parametrize("partitioner", ["dirichlet", "pathological"])
+def test_bank_content_independent_of_shard_layout(tmp_path, partitioner):
+    """`shard_clients` is an IO knob: any layout serves identical client
+    index lists and the same content_sha (and is excluded from bank_key)."""
+    labels = _labels(1000)
+    kw = dict(population=600, partitioner=partitioner,
+              samples_per_client=24, seed=3, log=lambda *_: None)
+    a = bank_mod.build_bank(str(tmp_path / "a"), labels,
+                            shard_clients=37, **kw)
+    b = bank_mod.build_bank(str(tmp_path / "b"), labels,
+                            shard_clients=65536, **kw)
+    assert a.meta["content_sha"] == b.meta["content_sha"]
+    assert a.meta["key"] == b.meta["key"]
+    assert a.meta["n_shards"] == 17 and b.meta["n_shards"] == 1
+    for cid in (0, 36, 37, 599):
+        np.testing.assert_array_equal(a.client_indices(cid),
+                                      b.client_indices(cid))
+
+
+def test_bank_key_tracks_partition_shaping_params():
+    labels = _labels(500)
+    base = dict(population=100, partitioner="dirichlet",
+                samples_per_client=16, dirichlet_alpha=0.5,
+                classes_per_client=2, seed=0, n_classes=10)
+    k0 = bank_mod.bank_key(labels, **base)
+    assert bank_mod.bank_key(labels, **base) == k0
+    for field, val in (("population", 200), ("seed", 1),
+                       ("dirichlet_alpha", 0.1), ("partitioner",
+                                                  "pathological"),
+                       ("samples_per_client", 32)):
+        assert bank_mod.bank_key(labels, **{**base, field: val}) != k0
+    assert bank_mod.bank_key(labels[:-1], **base) != k0  # dataset content
+    # gather-time padding is NOT a key input: a batch-size change reuses
+    # the bank (padding happens in padded_max_n at materialization)
+    import inspect
+    assert "pad_multiple" not in inspect.signature(
+        bank_mod.bank_key).parameters
+
+
+def test_samples_per_client_resolution():
+    assert bank_mod.resolve_samples_per_client(100, 2048, 10) == 100
+    # auto: even split clamped to [16, 4096]
+    assert bank_mod.resolve_samples_per_client(0, 60000, 10) == 4096
+    assert bank_mod.resolve_samples_per_client(0, 60000, 1000) == 60
+    assert bank_mod.resolve_samples_per_client(0, 60000, 10**6) == 16
+
+
+def test_dirichlet_partition_shape_and_skew(tmp_path):
+    labels = _labels(2000)
+    bank = bank_mod.build_bank(
+        str(tmp_path / "b"), labels, population=300,
+        partitioner="dirichlet", samples_per_client=32,
+        dirichlet_alpha=0.3, log=lambda *_: None)
+    assert bank.population == 300
+    assert bank.max_client_n == 32
+    n_class_sets = set()
+    for cid in range(300):
+        idx = np.asarray(bank.client_indices(cid))
+        assert len(idx) == 32
+        assert idx.min() >= 0 and idx.max() < 2000
+        n_class_sets.add(len(set(labels[idx])))
+    # alpha=0.3 is skewed: clients must NOT all see the full class set
+    assert min(n_class_sets) < 10
+
+
+def test_pathological_respects_classes_per_client(tmp_path):
+    labels = _labels(2000)
+    bank = bank_mod.build_bank(
+        str(tmp_path / "b"), labels, population=200,
+        partitioner="pathological", samples_per_client=30,
+        classes_per_client=2, log=lambda *_: None)
+    for cid in range(200):
+        idx = np.asarray(bank.client_indices(cid))
+        assert len(idx) == 30
+        assert len(set(labels[idx])) <= 2
+
+
+def test_get_or_build_reuses_matching_bank(tmp_path):
+    labels = _labels(800)
+    kw = dict(population=50, partitioner="dirichlet",
+              samples_per_client=16, dirichlet_alpha=0.5,
+              classes_per_client=2, n_classes=10, shard_clients=65536,
+              log=lambda *_: None)
+    d = str(tmp_path / "b")
+    b1, built1 = bank_mod.get_or_build(d, labels, seed=0, **kw)
+    b2, built2 = bank_mod.get_or_build(d, labels, seed=0, **kw)
+    assert built1 and not built2
+    assert b2.meta["content_sha"] == b1.meta["content_sha"]
+    # a shaping change invalidates in place
+    b3, built3 = bank_mod.get_or_build(d, labels, seed=7, **kw)
+    assert built3 and b3.meta["content_sha"] != b1.meta["content_sha"]
+
+
+_SUBPROC_BUILD = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        bank as bank_mod)
+    part, pop, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    labels = np.random.default_rng(0).integers(
+        0, 10, size=2000).astype(np.int64)
+    bank = bank_mod.build_bank(
+        out_dir, labels, population=pop, partitioner=part,
+        samples_per_client=16, seed=11, shard_clients=4096,
+        log=lambda *_: None)
+    probe = {str(c): np.asarray(bank.client_indices(c)).tolist()
+             for c in (0, 4095, 4096, pop - 1)}
+    print(json.dumps({"sha": bank.meta["content_sha"], "probe": probe}))
+""")
+
+
+@pytest.mark.parametrize("partitioner", ["dirichlet", "pathological"])
+def test_100k_partition_fingerprint_stable_across_processes(
+        tmp_path, partitioner):
+    """ISSUE 7 satellite: 100k-client partitions are bitwise identical
+    when built by a different process (content is a pure function of
+    (seed, client), never of build order, shard layout, or process
+    state), pinned via content_sha + probed per-client index lists."""
+    pop = 100_000
+    labels = np.random.default_rng(0).integers(
+        0, 10, size=2000).astype(np.int64)
+    here = bank_mod.build_bank(
+        str(tmp_path / "here"), labels, population=pop,
+        partitioner=partitioner, samples_per_client=16, seed=11,
+        shard_clients=65536, log=lambda *_: None)   # different layout
+    out = subprocess.run(
+        [sys.executable, "-c", _SUBPROC_BUILD, partitioner, str(pop),
+         str(tmp_path / "there")],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    assert got["sha"] == here.meta["content_sha"]
+    for cid, idx in got["probe"].items():
+        np.testing.assert_array_equal(
+            np.asarray(here.client_indices(int(cid))), np.asarray(idx))
+
+
+_SUBPROC_RSS = textwrap.dedent("""
+    import json, resource, sys
+    import numpy as np
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        bank as bank_mod)
+    pop, out_dir = int(sys.argv[1]), sys.argv[2]
+    labels = np.random.default_rng(0).integers(
+        0, 10, size=2000).astype(np.int64)
+    images = np.random.default_rng(1).random((2000, 8, 8, 1)).astype(
+        np.float32)
+    bank = bank_mod.build_bank(
+        out_dir, labels, population=pop, partitioner="dirichlet",
+        samples_per_client=16, seed=0, log=lambda *_: None)
+    rng = np.random.default_rng(2)
+    for _ in range(5):
+        ids = rng.integers(0, pop, size=64)
+        bank.gather(ids, images, labels.astype(np.int32), 16)
+    print(json.dumps({"maxrss_kib":
+                      resource.getrusage(resource.RUSAGE_SELF).ru_maxrss}))
+""")
+
+
+def test_host_rss_constant_in_population():
+    """The constant-memory claim, host side: build + open + cohort-gather
+    at 10k and at 100k clients in fresh processes — peak RSS may not grow
+    with the population beyond the offset array's O(K) int64s (~0.8 MiB
+    at 100k) plus slack. A dense [K, max_n, 8, 8, 1] float32 stack would
+    add ~230 MiB at 100k, so the 48 MiB envelope catches any dense
+    materialization."""
+    import tempfile
+    rss = {}
+    for pop in (10_000, 100_000):
+        with tempfile.TemporaryDirectory() as d:
+            out = subprocess.run(
+                [sys.executable, "-c", _SUBPROC_RSS, str(pop),
+                 os.path.join(d, "bank")],
+                capture_output=True, text=True, timeout=300)
+            assert out.returncode == 0, out.stderr[-2000:]
+            rss[pop] = json.loads(
+                out.stdout.strip().splitlines()[-1])["maxrss_kib"]
+    assert rss[100_000] <= rss[10_000] + 48 * 1024, rss
+
+
+# --------------------------------------------------- cohort sampling ------
+
+def _cfg(**kw):
+    kw.setdefault("data", "synthetic")
+    kw.setdefault("bs", 16)
+    kw.setdefault("local_ep", 1)
+    return Config(**kw)
+
+
+def test_cohort_ids_dedup_and_range():
+    cfg = _cfg(num_agents=5000, cohort_sampled="on", cohort_size=16,
+               partitioner="dirichlet")
+    for rnd in range(1, 8):
+        ids, active = cohort_mod.sample_cohort_host(cfg, rnd)
+        assert ids.shape == (16,) and ids.dtype == np.int32
+        assert active.shape == (16,)
+        assert ids.min() >= 0 and ids.max() < 5000
+        live = ids[active]
+        assert len(set(live.tolist())) == len(live)  # no dup among active
+
+
+def test_cohort_host_mirror_matches_traced_draw():
+    """The driver's gather and the program's in-jit draw are the same
+    function of the round index — bit-identical ids and active mask."""
+    cfg = _cfg(num_agents=2048, cohort_sampled="on", cohort_size=8)
+    traced = jax.jit(lambda r: cohort_mod.sample_cohort(cfg, r))
+    for rnd in (1, 5, 173):
+        ids_t, act_t = traced(jnp.int32(rnd))
+        ids_h, act_h = cohort_mod.sample_cohort_host(cfg, rnd)
+        np.testing.assert_array_equal(np.asarray(ids_t), ids_h)
+        np.testing.assert_array_equal(np.asarray(act_t), act_h)
+
+
+def test_cohort_draw_varies_by_round_and_seed():
+    cfg = _cfg(num_agents=2048, cohort_sampled="on", cohort_size=8)
+    ids1, _ = cohort_mod.sample_cohort_host(cfg, 1)
+    ids2, _ = cohort_mod.sample_cohort_host(cfg, 2)
+    assert not np.array_equal(ids1, ids2)
+    ids1b, _ = cohort_mod.sample_cohort_host(
+        cfg.replace(cohort_seed=99), 1)
+    assert not np.array_equal(ids1, ids1b)
+    # and cohort_seed is independent of the training seed
+    ids1c, _ = cohort_mod.sample_cohort_host(cfg.replace(seed=123), 1)
+    np.testing.assert_array_equal(ids1, ids1c)
+
+
+def test_cohort_sampled_from_churn_present_set():
+    """Churn-aware cohorting: every ACTIVE cohort slot holds a client
+    that is churn-present this round (the old host-sampled + churn
+    refusal is retired by sampling from the present set)."""
+    cfg = _cfg(num_agents=4096, cohort_sampled="on", cohort_size=16,
+               churn_available=0.5, churn_period=4)
+    assert cfg.churn_enabled
+    seen_active = 0
+    for rnd in range(1, 6):
+        ids, active = cohort_mod.sample_cohort_host(cfg, rnd)
+        present = np.asarray(churn_mod.active_slots(
+            cfg, jnp.asarray(ids), rnd))
+        assert not np.any(active & ~present)
+        seen_active += int(active.sum())
+    assert seen_active > 0
+
+
+def test_cohort_shortfall_pads_with_inactive_slots():
+    """m > population forces a shortfall: the cohort keeps its static
+    shape, surplus slots are active=False (participation-masked), and
+    every distinct client appears at most once among the active slots."""
+    cfg = _cfg(num_agents=2, cohort_sampled="on", cohort_size=4)
+    ids, active = cohort_mod.sample_cohort_host(cfg, 1)
+    assert ids.shape == (4,)
+    assert active.sum() <= 2
+    live = ids[active]
+    assert len(set(live.tolist())) == len(live)
+
+
+def test_oversample_cap_is_loud():
+    cfg = _cfg(num_agents=10**6, cohort_sampled="on", cohort_size=4096)
+    with pytest.raises(ValueError, match="MAX_CANDIDATES"):
+        cohort_mod.oversample_count(cfg)
+
+
+def test_cohort_mode_selection():
+    """auto turns on at the population threshold when the implied cohort
+    is samplable; explicit on/off wins; paper-scale configs stay on
+    their historical dense path."""
+    assert not is_cohort_mode(_cfg(num_agents=10))
+    assert not is_cohort_mode(_cfg(num_agents=40))
+    assert is_cohort_mode(_cfg(num_agents=4096, cohort_size=64))
+    assert is_cohort_mode(_cfg(num_agents=8192, agent_frac=0.01))
+    # auto must NOT crash a previously-working dense config whose
+    # implied cohort is population-sized (default agent_frac 1.0 =>
+    # m = K > MAX_CANDIDATES): infeasible stays dense
+    assert not is_cohort_mode(_cfg(num_agents=5000))
+    assert is_cohort_mode(_cfg(num_agents=10, cohort_sampled="on"))
+    assert not is_cohort_mode(_cfg(num_agents=10**6,
+                                   cohort_sampled="off"))
+
+
+def test_cohort_config_surface():
+    """cohort_size overrides the legacy agent_frac product; the new
+    fields all carry provenance tags (the fail-closed audit's contract);
+    the run_name grows a population cell only in cohort mode."""
+    assert _cfg(num_agents=100).agents_per_round == 100
+    assert _cfg(num_agents=100, cohort_size=8).agents_per_round == 8
+    for f in ("cohort_sampled", "cohort_size", "cohort_seed",
+              "partitioner", "dirichlet_alpha", "classes_per_client",
+              "samples_per_client", "bank_dir", "bank_shard_clients"):
+        assert f in FIELD_PROVENANCE, f
+    dense = _cfg(num_agents=10)
+    coh = _cfg(num_agents=5000, cohort_size=8, partitioner="dirichlet")
+    assert "-coh:" not in run_name(dense)
+    assert "-coh:K5000m8-dirichlet" in run_name(coh)
+    # partition-shaping params separate run dirs too
+    assert run_name(coh) != run_name(coh.replace(dirichlet_alpha=0.1))
+    assert run_name(coh) != run_name(coh.replace(samples_per_client=64))
+    # churn runs carry the cell too: a host-sampled run under churn
+    # reroutes to the cohort program at engine construction (a data-size
+    # decision run_name cannot see), and its results then depend on
+    # cohort_seed — two such runs must not share a run dir
+    chrn = _cfg(num_agents=10, churn_available=0.5)
+    assert "-coh:" in run_name(chrn)
+    assert run_name(chrn) != run_name(chrn.replace(cohort_seed=1))
+
+
+# ------------------------------------- programs + metrics bookkeeping ------
+
+def _cohort_env(tmp_path, **kw):
+    cfg = _cfg(num_agents=512, cohort_sampled="on", cohort_size=8,
+               partitioner="dirichlet", num_corrupt=3, poison_frac=0.5,
+               robustLR_threshold=2,
+               data_dir=str(tmp_path / "nodata"),
+               log_dir=str(tmp_path), **kw)
+    src = get_cohort_data(cfg)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_cohort_round_fn)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params)
+    model = get_model(cfg.data, cfg.model_arch, cfg.dtype)
+    norm = make_normalizer(src.mean, src.std, src.raw_is_normalized)
+    fn = make_cohort_round_fn(cfg, model, norm)
+    params = init_params(model, src.base_images.shape[1:],
+                         jax.random.PRNGKey(0))
+    def step(rnd):
+        ids, _ = cohort_mod.sample_cohort_host(cfg, rnd)
+        imgs, lbls, szs = src.gather_cohort(ids)
+        _, info = fn(params, jax.random.PRNGKey(rnd), jnp.int32(rnd),
+                     jnp.asarray(imgs), jnp.asarray(lbls),
+                     jnp.asarray(szs))
+        return ids, info
+    return cfg, step
+
+
+def _find_rounds(cfg, max_rounds=400):
+    """(round with NO corrupt client sampled, round with >= 1) — both
+    with a full active cohort so electorate size is exactly m."""
+    r_no = r_yes = None
+    for rnd in range(1, max_rounds):
+        ids, active = cohort_mod.sample_cohort_host(cfg, rnd)
+        if not active.all():
+            continue
+        n_cor = int((ids < cfg.num_corrupt).sum())
+        if n_cor == 0 and r_no is None:
+            r_no = rnd
+        if n_cor > 0 and r_yes is None:
+            r_yes = rnd
+        if r_no and r_yes:
+            return r_no, r_yes
+    raise AssertionError("no suitable rounds found")
+
+
+def test_defense_cosine_split_over_cohort_membership(tmp_path):
+    """ISSUE 7 satellite: the Defense/* honest/corrupt cosine split is a
+    function of cohort membership (real client ids), not slot position.
+    A round that samples no corrupt client reports a zero corrupt
+    electorate — the old slot-indexed flags would have called slots
+    0..num_corrupt-1 corrupt every round."""
+    cfg, step = _cohort_env(tmp_path, telemetry="full")
+    r_no, r_yes = _find_rounds(cfg)
+    ids_no, info_no = step(r_no)
+    assert not np.any(ids_no < cfg.num_corrupt)
+    assert float(info_no["tel_cos_corrupt"]) == 0.0   # empty electorate
+    assert float(info_no["tel_cos_honest"]) != 0.0
+    ids_yes, info_yes = step(r_yes)
+    assert np.any(ids_yes < cfg.num_corrupt)
+    assert float(info_yes["tel_cos_corrupt"]) != 0.0
+
+
+def test_faults_rates_over_cohort_membership(tmp_path):
+    """--faults_spare_corrupt under cohort sampling: the spared set is
+    the round's sampled corrupt MEMBERS. dropout=1.0 makes the arithmetic
+    exact — dropped == m minus the number of corrupt clients actually in
+    this cohort (slot-indexed flags would spare a fixed count)."""
+    cfg, step = _cohort_env(tmp_path, dropout_rate=1.0,
+                            faults_spare_corrupt=True)
+    m = cfg.agents_per_round
+    r_no, r_yes = _find_rounds(cfg)
+    # slot-indexed flags would spare slots 0..num_corrupt-1 EVERY round:
+    # dropped would be a constant m - 3. Membership flags instead spare
+    # only sampled corrupt clients: with none sampled, everyone drops and
+    # the all-drop guard retains exactly one honest voter.
+    ids_no, info_no = step(r_no)
+    assert float(info_no["fault_dropped"]) == m - 1
+    assert float(info_no["fault_voters"]) == 1.0
+    assert float(info_no["fault_dropped"]) != m - cfg.num_corrupt
+    ids_yes, info_yes = step(r_yes)
+    n_cor = int((ids_yes < cfg.num_corrupt).sum())
+    assert float(info_yes["fault_dropped"]) == m - n_cor
+    assert float(info_yes["fault_voters"]) == n_cor
+
+
+def test_program_draw_matches_host_mirror(tmp_path):
+    """The `sampled` ids the round PROGRAM recomputed in-jit equal the
+    ids the driver's host mirror gathered — the contract the whole
+    cohort-gather protocol rests on."""
+    cfg, step = _cohort_env(tmp_path)
+    for rnd in (1, 2, 77):
+        ids, info = step(rnd)
+        np.testing.assert_array_equal(np.asarray(info["sampled"]), ids)
+
+
+def test_driver_cohort_e2e_auto_threshold(tmp_path, capsys):
+    """train.run end-to-end on a 4096-client population: auto-selects
+    the cohort path, builds the bank, trains, and reports."""
+    cfg = _cfg(num_agents=4096, cohort_size=4, partitioner="dirichlet",
+               rounds=2, snap=2, num_corrupt=64, poison_frac=0.5,
+               data_dir=str(tmp_path / "nodata"),
+               log_dir=str(tmp_path / "logs"), compile_cache=False,
+               tensorboard=False, spans=False, heartbeat=False)
+    train.run(cfg, writer=NullWriter())
+    out = capsys.readouterr().out
+    assert "[cohort] population 4,096 clients -> 4-client cohorts" in out
+    assert "[bank] dirichlet partition of 4,096 clients" in out
+
+
+def test_host_sampled_churn_routes_to_cohort(tmp_path, capsys,
+                                             monkeypatch):
+    """ROADMAP carry-over: host-sampled + churn used to be refused
+    loudly; it now routes through the cohort program, sampling cohorts
+    from the churn-present set over the dense host stacks."""
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    cfg = _cfg(num_agents=8, rounds=2, snap=2,
+               churn_available=0.6, churn_period=4,
+               data_dir=str(tmp_path / "nodata"),
+               log_dir=str(tmp_path / "logs"), compile_cache=False,
+               tensorboard=False, spans=False, heartbeat=False)
+    train.run(cfg, writer=NullWriter())
+    out = capsys.readouterr().out
+    assert "host-sampled + churn: cohorts are sampled" in out
+    assert "churn-present set" in out
+
+
+def test_host_churn_with_cohort_off_still_refuses(tmp_path, monkeypatch):
+    """The reroute honors an explicit --cohort_sampled off: the refusal
+    stays loud (the planner would plan host families the cohort driver
+    never dispatches) instead of silently overriding the opt-out."""
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    cfg = _cfg(num_agents=8, rounds=2, snap=2, cohort_sampled="off",
+               churn_available=0.6, churn_period=4,
+               data_dir=str(tmp_path / "nodata"),
+               log_dir=str(tmp_path / "logs"), compile_cache=False,
+               tensorboard=False, spans=False, heartbeat=False)
+    with pytest.raises(ValueError, match="host-sampled \\+ churn"):
+        train.run(cfg, writer=NullWriter())

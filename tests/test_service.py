@@ -486,7 +486,7 @@ SVC = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
              churn_available=0.75, churn_period=3,
              service_backoff_s=0.01)
 
-EXCLUDE = ("Throughput/", "Service/", "Spans/", "_run/")
+EXCLUDE = ("Throughput/", "Service/", "Spans/", "Memory/", "_run/")
 
 
 @pytest.fixture(scope="module")
