@@ -220,6 +220,15 @@ def main():
                     help="samples per client on the ladder rungs (0 = "
                          "auto clamp; the SAME value lands on every rung, "
                          "so rung rounds/sec are compute-comparable)")
+    ap.add_argument("--agg_layout", choices=("leaf", "bucket", "both"),
+                    default="",
+                    help="A/B the sharded aggregation collective shape "
+                         "(ISSUE 8, parallel/buckets.py): measure "
+                         "rounds/sec of the shard_map round program under "
+                         "the per-leaf psum plan and/or the bucketed "
+                         "reduce-scatter plan on the local mesh, with "
+                         "jaxpr + compiled-HLO collective counts per "
+                         "layout in the output JSON (agg_layout_ab)")
     ap.add_argument("--status_file", default="logs/status.json",
                     help="heartbeat path (obs/heartbeat.py) the session "
                          "stall detector reads; empty disables")
@@ -796,6 +805,97 @@ def main():
     except Exception as e:  # informative, never fatal
         log(f"[bench] host-sync probe unavailable: {e}")
 
+    agg_ab_out = None
+    if args.agg_layout:
+        # sharded-layout A/B (ISSUE 8): the SAME flagship config through
+        # the shard_map round program under each aggregation layout, on
+        # the largest local mesh dividing m. Per-round dispatch (no
+        # chain: XLA:CPU's conv-in-while slow path would swamp the
+        # collective delta on the fallback host); each layout reports
+        # steady rounds/sec plus its jaxpr + compiled-HLO collective
+        # counts, so the A/B carries the communication-plan evidence
+        # next to the throughput it buys.
+        from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+            jaxpr_lint)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
+            make_mesh, pick_agent_mesh_size)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+            make_sharded_round_fn)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+            _pallas_applicable)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+            _bucket_applicable)
+        d = pick_agent_mesh_size(0, cfg.agents_per_round)
+        layouts = (("leaf", "bucket") if args.agg_layout == "both"
+                   else (args.agg_layout,))
+        if d <= 1:
+            agg_ab_out = {"note": f"needs >1 devices dividing "
+                                  f"agents_per_round={cfg.agents_per_round}"
+                                  f" (have {jax.device_count()})"}
+            log(f"[bench] agg-layout A/B skipped: {agg_ab_out['note']}")
+        elif _pallas_applicable(cfg) or not _bucket_applicable(
+                cfg.replace(agg_layout="bucket")):
+            # the bucket flag would be a no-op here (the fused pallas
+            # step wins the plan precedence exactly when
+            # _pallas_applicable holds; non-avg/sign rules keep their
+            # transpose plans) — measuring two identical programs as an
+            # A/B would be a lie
+            agg_ab_out = {"note": f"config never buckets "
+                                  f"(pallas={_pallas_applicable(cfg)}, "
+                                  f"aggr={cfg.aggr!r}); both layouts "
+                                  f"would trace the same program"}
+            log(f"[bench] agg-layout A/B skipped: {agg_ab_out['note']}")
+        else:
+            mesh = make_mesh(d)
+            agg_ab_out = {"mesh": d}
+            n_rounds = args.blocks * chain
+            hb.update(phase="agg_ab", force=True)
+            for lay in layouts:
+                lcfg = cfg.replace(agg_layout=lay)
+                sp = init_params(model, fed.train.images.shape[2:],
+                                 jax.random.PRNGKey(0))
+                fn = make_sharded_round_fn(lcfg, model, norm, mesh,
+                                           *arrays)
+                ab = compile_cache.abstractify
+                ex = (ab(sp), ab(jax.random.PRNGKey(0))) + arrays
+                closed = compile_cache.trace_program(fn.jitted, ex)
+                counts = {k: v for k, v in
+                          jaxpr_lint.collective_counts(closed).items()
+                          if v}
+                # ONE compile per layout: the Compiled that yields the
+                # HLO counts also drives the measurement (calling the
+                # bound fn instead would jit-compile the same program a
+                # second time — tens of seconds each on the CPU fallback)
+                compiled = compile_cache.lower_program(
+                    fn.jitted, ex).compile()
+                hcounts = jaxpr_lint.hlo_collective_counts(
+                    compiled.as_text())
+                with tracer.span("bench/agg_ab_first", layout=lay):
+                    key = jax.random.PRNGKey(1)
+                    sp, _ = compiled(sp, key, *arrays)
+                    jax.block_until_ready(sp)
+                t0 = time.perf_counter()
+                with tracer.span("bench/agg_ab_steady", layout=lay,
+                                 rounds=n_rounds):
+                    for r in range(n_rounds):
+                        key = jax.random.fold_in(jax.random.PRNGKey(1), r)
+                        sp, _ = compiled(sp, key, *arrays)
+                    jax.block_until_ready(sp)
+                rps = n_rounds / (time.perf_counter() - t0)
+                agg_ab_out[lay] = {
+                    "rounds_per_sec": round(rps, 4),
+                    "jaxpr_collectives": counts,
+                    "hlo_collectives": hcounts,
+                }
+                log(f"[bench] agg_layout={lay}: {rps:.3f} rounds/sec on "
+                    f"the {d}-way mesh | jaxpr {counts} | hlo {hcounts}")
+            if len(layouts) == 2:
+                agg_ab_out["bucket_vs_leaf"] = round(
+                    agg_ab_out["bucket"]["rounds_per_sec"]
+                    / agg_ab_out["leaf"]["rounds_per_sec"], 4)
+                log(f"[bench] bucket/leaf throughput ratio: "
+                    f"{agg_ab_out['bucket_vs_leaf']:.3f}x")
+
     vs_baseline = None
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BASELINE_MEASURED.json")
@@ -852,6 +952,8 @@ def main():
         out["population"] = population_out
     if attribution_out is not None:
         out["attribution"] = attribution_out
+    if agg_ab_out is not None:
+        out["agg_layout_ab"] = agg_ab_out
     if hbm:
         out["hbm"] = hbm
     # per-phase span aggregates (obs/spans.py): where this bench's wall

@@ -36,6 +36,11 @@ def main(argv=None) -> int:
                     help="additionally compile checked families and "
                          "assert post-optimization HLO collective "
                          "ceilings (the CSE claims)")
+    ap.add_argument("--topologies", default="",
+                    help="comma list of sharded mesh sizes to judge "
+                         "(e.g. 1,8,16); empty = every "
+                         "contracts.TOPOLOGIES entry the faked device "
+                         "count allows")
     ap.add_argument("--write-baseline", action="store_true",
                     help="record the measured per-family counts into "
                          "analysis_baseline.json instead of failing on "
@@ -82,8 +87,11 @@ def main(argv=None) -> int:
                 jax.config.update("jax_platforms", args.platform)
             from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
                 jaxpr_lint)
+            topologies = ([int(t) for t in args.topologies.split(",")]
+                          if args.topologies else None)
             jfind, baseline = jaxpr_lint.run(sharded=args.sharded,
-                                             compiled=args.compiled)
+                                             compiled=args.compiled,
+                                             topologies=topologies)
             findings.extend(jfind)
             if args.write_baseline:
                 path = jaxpr_lint.write_baseline(root, baseline)

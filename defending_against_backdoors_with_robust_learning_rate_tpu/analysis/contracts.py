@@ -119,6 +119,17 @@ FORBIDDEN_PRIMITIVES = frozenset({
 COLLECTIVE_PRIMITIVES = ("psum", "all_gather", "all_to_all", "ppermute",
                          "pmin", "pmax", "reduce_scatter")
 
+# mesh-axis sizes the sharded contracts are traced and judged at: single
+# chip, the 8-way CI mesh, and a 16-way pod shape. Counts are the same at
+# every topology BY DESIGN (the communication plans are topology-free);
+# tracing each size proves it — the bucketed reduce-scatter plan must not
+# grow collectives with the mesh. The reference topology keeps the
+# historical (unsuffixed) baseline keys; other sizes record as
+# `<name>@<d>w`. Topologies above the process's faked device count are
+# skipped (tier-1 runs under 8; scripts/check_static.py forces 16).
+TOPOLOGIES = (1, 8, 16)
+REFERENCE_TOPOLOGY = 8
+
 
 @dataclasses.dataclass(frozen=True)
 class CheckSpec:
@@ -285,6 +296,72 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         collective_budget={**zero, "psum": 2 * n_leaves + 2,
                            "all_gather": 1},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # bucketed reduce-scatter layout (ISSUE 8, parallel/buckets.py): the
+    # pod-shape plan for the psum-shaped rules. avg + RLR costs ONE
+    # reduce-scatter per bucket (the weighted sum and the sign vote ride
+    # the SAME collective as stacked rows) + ONE all_gather of the
+    # already-LR-scaled result + the scalar weight-total psum + the loss
+    # pmean — 4 collectives total on the flagship (1 bucket) vs the leaf
+    # layout's 2L+2 = 18 psums. sign + RLR drops the weight psum (3).
+    # Faults still add exactly the one [m]-bit validation all_gather.
+    # Telemetry: the flip/vote stats ride the result all_gather (zero
+    # extra collectives); full adds the SAME 3 tiny all_gathers as the
+    # leaf plan (norms + two cosine accumulators). The HLO ceilings keep
+    # the measured +3 GSPMD constant; XLA's combiner may merge the two
+    # scalar psums below it (baseline pins the exact counts).
+    bucket = {"agg_layout": "bucket"}
+    rs_budget = {**zero, "psum": 2, "reduce_scatter": 1, "all_gather": 1}
+    specs["sharded_rlr_avg_bucket"] = CheckSpec(
+        name="sharded_rlr_avg_bucket", family="round_sharded",
+        sharded=True, cfg_overrides=dict(bucket),
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_sign_bucket"] = CheckSpec(
+        name="sharded_rlr_sign_bucket", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**bucket, "aggr": "sign", "server_lr": 1.0},
+        collective_budget={**rs_budget, "psum": 1},
+        hlo_all_reduce_max=1 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_faults"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_faults", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**bucket, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**rs_budget, "all_gather": 2},
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_tel_full"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_tel_full", family="round_sharded",
+        sharded=True, cfg_overrides={**bucket, "telemetry": "full"},
+        collective_budget={**rs_budget, "all_gather": 4},
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_sign_bucket_tel_full"] = CheckSpec(
+        name="sharded_rlr_sign_bucket_tel_full", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**bucket, "aggr": "sign", "server_lr": 1.0,
+                       "telemetry": "full"},
+        collective_budget={**rs_budget, "psum": 1, "all_gather": 4},
+        hlo_all_reduce_max=1 + spmd_overhead)
+    # the bucketed body rides every dispatch surface unchanged: the
+    # host-sampled variant, the chained lax.scan block, and the
+    # cohort-sampled family keep the identical plan
+    specs["sharded_host_rlr_avg_bucket"] = CheckSpec(
+        name="sharded_host_rlr_avg_bucket", family="round_sharded_host",
+        sharded=True, host_mode=True, cfg_overrides=dict(bucket),
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_chained_rlr_avg_bucket"] = CheckSpec(
+        name="sharded_chained_rlr_avg_bucket", family="chained_sharded",
+        sharded=True, cfg_overrides={**bucket, "chain": 2, "snap": 2},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_cohort"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_cohort",
+        family="round_sharded_cohort", sharded=True,
+        cfg_overrides={**bucket, "cohort_sampled": "on"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
 
     # cohort-sampled population axis (ISSUE 7, data/cohort.py): the
     # in-program cohort draw + active mask are replicated computations

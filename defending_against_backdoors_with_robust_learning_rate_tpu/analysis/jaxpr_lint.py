@@ -159,10 +159,19 @@ def _build_env(cfg):
     return fed, model, norm
 
 
-def _make_mesh_for(cfg):
+def _make_mesh_for(cfg, mesh_size: int = 0):
     import jax
     from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
         make_mesh, pick_agent_mesh_size)
+    if mesh_size:
+        # explicit topology (the per-topology contract matrix): a 1-way
+        # mesh is legitimate here — the collectives still trace
+        if mesh_size > jax.device_count():
+            raise RuntimeError(
+                f"topology {mesh_size} needs {mesh_size} devices, have "
+                f"{jax.device_count()} (XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={mesh_size})")
+        return make_mesh(mesh_size)
     d = pick_agent_mesh_size(0, cfg.agents_per_round)
     if d <= 1:
         raise RuntimeError(
@@ -173,15 +182,26 @@ def _make_mesh_for(cfg):
     return make_mesh(d)
 
 
-def build_family(check: "contracts.CheckSpec"):
+def build_family(check: "contracts.CheckSpec", mesh_size: int = 0):
     """(jit_obj, example_args) for one CheckSpec — via the compile-cache
-    planners so the analysis surface and the AOT surface cannot drift."""
+    planners so the analysis surface and the AOT surface cannot drift.
+    `mesh_size` pins the sharded topology (contracts.TOPOLOGIES); 0 keeps
+    the historical pick (all devices dividing m). The check config's
+    population grows to the topology when m would not divide it (the
+    budgets are participant-count-free)."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
     cfg = contracts.base_check_config().replace(**check.cfg_overrides)
+    if check.sharded and mesh_size and \
+            cfg.agents_per_round % mesh_size != 0:
+        # agent_frac=1 -> m = d; the synthetic set must still deal
+        # K x 10 class-shards (data/partition.py bound)
+        cfg = cfg.replace(num_agents=mesh_size,
+                          synth_train_size=max(cfg.synth_train_size,
+                                               20 * mesh_size))
     fed, model, norm = _build_env(cfg)
     if check.sharded:
-        mesh = _make_mesh_for(cfg)
+        mesh = _make_mesh_for(cfg, mesh_size)
         specs = compile_cache.plan_sharded_programs(
             cfg, model, norm, fed, mesh, host_mode=check.host_mode)
     else:
@@ -198,13 +218,15 @@ def build_family(check: "contracts.CheckSpec"):
 # checks
 # --------------------------------------------------------------------------
 
-def check_family(check: "contracts.CheckSpec", compiled: bool = False
+def check_family(check: "contracts.CheckSpec", compiled: bool = False,
+                 mesh_size: int = 0
                  ) -> Tuple[List[Finding], Dict[str, Any]]:
-    """Run one CheckSpec. Returns (findings, baseline_record)."""
+    """Run one CheckSpec (optionally at an explicit sharded topology).
+    Returns (findings, baseline_record)."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
     path = f"{contracts.PKG}/analysis/contracts.py"
-    jit_obj, example_args = build_family(check)
+    jit_obj, example_args = build_family(check, mesh_size=mesh_size)
     with _rolled_scans():
         closed = compile_cache.trace_program(jit_obj, example_args)
     findings: List[Finding] = []
@@ -252,54 +274,95 @@ def check_family(check: "contracts.CheckSpec", compiled: bool = False
 
 
 def telemetry_off_findings(sharded: bool = False) -> List[Finding]:
-    """Trace the round family with obs.telemetry.compute* replaced by a
-    tripwire: --telemetry off lowering must not touch the telemetry
-    module at all (the bit-identity contract, made structural)."""
+    """Trace the round families with EVERY obs.telemetry entry point
+    replaced by a tripwire: --telemetry off lowering must not touch the
+    telemetry module at all (the bit-identity contract, made
+    structural). The sharded pass traces the leaf AND the bucketed
+    aggregation programs — the bucket path has its own telemetry hooks
+    (shard_vote_stats / compute_sharded_bucket) that must stay equally
+    dead under off."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
         telemetry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
     path = f"{contracts.PKG}/obs/telemetry.py"
-    check = contracts.check_specs()[
-        "sharded_rlr_avg" if sharded else "vmap_rlr_avg"]
-    assert contracts.base_check_config().replace(
-        **check.cfg_overrides).telemetry == "off"
+    specs = contracts.check_specs()
+    names = (("sharded_rlr_avg", "sharded_rlr_avg_bucket") if sharded
+             else ("vmap_rlr_avg",))
 
     def tripwire(*_a, **_k):
         raise AssertionError("telemetry computed under --telemetry off")
 
-    orig = telemetry.compute, telemetry.compute_sharded
-    telemetry.compute = telemetry.compute_sharded = tripwire
+    hooks = ("compute", "compute_sharded", "compute_sharded_bucket",
+             "shard_vote_stats")
+    orig = {h: getattr(telemetry, h) for h in hooks}
+    for h in hooks:
+        setattr(telemetry, h, tripwire)
+    findings: List[Finding] = []
     try:
-        jit_obj, example_args = build_family(check)
-        with _rolled_scans():
-            compile_cache.trace_program(jit_obj, example_args)
-    except AssertionError as e:
-        return [Finding("telemetry-off-leak", path, 1,
-                        f"{check.name}: {e} — the off level must add "
-                        f"nothing to the traced program")]
+        for name in names:
+            check = specs[name]
+            assert contracts.base_check_config().replace(
+                **check.cfg_overrides).telemetry == "off"
+            try:
+                jit_obj, example_args = build_family(check)
+                with _rolled_scans():
+                    compile_cache.trace_program(jit_obj, example_args)
+            except AssertionError as e:
+                findings.append(Finding(
+                    "telemetry-off-leak", path, 1,
+                    f"{check.name}: {e} — the off level must add "
+                    f"nothing to the traced program"))
     finally:
-        telemetry.compute, telemetry.compute_sharded = orig
-    return []
+        for h, fn in orig.items():
+            setattr(telemetry, h, fn)
+    return findings
 
 
 # --------------------------------------------------------------------------
 # driver + baseline
 # --------------------------------------------------------------------------
 
-def run(sharded: bool = False, compiled: bool = False
-        ) -> Tuple[List[Finding], Dict[str, Any]]:
+def run(sharded: bool = False, compiled: bool = False,
+        topologies=None) -> Tuple[List[Finding], Dict[str, Any]]:
     """All jaxpr contracts (vmap always; shard_map families when
-    `sharded`). Returns (findings, baseline dict)."""
+    `sharded`, each traced at every requested topology — default: every
+    contracts.TOPOLOGIES entry the faked device count allows). The
+    REFERENCE_TOPOLOGY keeps the historical unsuffixed baseline keys;
+    other sizes record as `<name>@<d>w`. Returns (findings, baseline)."""
     import jax
     findings: List[Finding] = []
     families: Dict[str, Any] = {}
+    if topologies is None:
+        topologies = [d for d in contracts.TOPOLOGIES
+                      if d <= jax.device_count()]
+    else:
+        # an EXPLICIT topology request must not silently shrink: a gate
+        # invoked for the pod shape that quietly traces nothing would
+        # report green with zero coverage at the requested width
+        too_wide = [d for d in topologies if d > jax.device_count()]
+        if too_wide:
+            raise RuntimeError(
+                f"requested topologies {too_wide} exceed the "
+                f"{jax.device_count()} faked devices; run under "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{max(too_wide)}")
     for name, check in sorted(contracts.check_specs().items()):
         if check.sharded and not sharded:
             continue
-        f, record = check_family(check, compiled=compiled)
-        findings.extend(f)
-        families[name] = record
+        if not check.sharded:
+            f, record = check_family(check, compiled=compiled)
+            findings.extend(f)
+            families[name] = record
+            continue
+        for d in topologies:
+            f, record = check_family(check, compiled=compiled,
+                                     mesh_size=d)
+            findings.extend(f)
+            record["topology"] = d
+            key = (name if d == contracts.REFERENCE_TOPOLOGY
+                   else f"{name}@{d}w")
+            families[key] = record
     findings.extend(telemetry_off_findings(sharded=False))
     if sharded:
         findings.extend(telemetry_off_findings(sharded=True))

@@ -69,6 +69,19 @@ class Config:
                                     # must resume under the impl that
                                     # wrote it (key data shapes differ).
     mesh: int = 1                   # devices on the `agents` mesh axis; 0 = all
+    agg_layout: str = "leaf"        # leaf | bucket — sharded aggregation
+                                    # collective shape (parallel/rounds.py):
+                                    # leaf = one psum per parameter leaf
+                                    # (2L+2 on the flagship; free on one
+                                    # chip); bucket = flatten updates into
+                                    # fixed-size buckets, ONE reduce-
+                                    # scatter per bucket, avg + RLR vote
+                                    # computed on the scattered shard, one
+                                    # all-gather of the LR-scaled result
+                                    # (parallel/buckets.py — the pod
+                                    # shape). leaf stays the default until
+                                    # the TPU A/B lands (bench.py
+                                    # --agg_layout)
     chain: int = 1                  # rounds fused per dispatch via lax.scan
                                     # (capped at `snap`; >1 kills per-round
                                     # host dispatch overhead, bit-identical)
@@ -333,6 +346,10 @@ FIELD_PROVENANCE = {
     "mesh": "runtime",            # sharded families are never banked; the
                                   # mesh-independent eval/vmap programs
                                   # should be shared across mesh settings
+    "agg_layout": "program",      # selects the sharded aggregation
+                                  # collective plan (per-leaf psums vs
+                                  # bucketed reduce-scatter) — a traced
+                                  # program difference
     "chain": "shape",             # round_ids aval pins the block length
     "host_prefetch": "runtime",
     "host_sampled": "runtime",    # selects the family; family names key
@@ -472,6 +489,14 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    help="multi-host: this process's id; -1 = auto")
     p.add_argument("--mesh", type=int, default=d.mesh,
                    help="devices on the `agents` mesh axis (0=all local devices)")
+    p.add_argument("--agg_layout", choices=("leaf", "bucket"),
+                   default=d.agg_layout,
+                   help="sharded aggregation collective shape: leaf = one "
+                        "psum per parameter leaf (single-chip shape); "
+                        "bucket = bucketed reduce-scatter + all-gather of "
+                        "the LR-scaled result with the RLR vote computed "
+                        "on the scattered shard (pod shape, "
+                        "parallel/buckets.py)")
     p.add_argument("--chain", type=int, default=d.chain,
                    help="rounds fused into one compiled lax.scan dispatch "
                         "(capped at --snap so eval cadence is unchanged)")

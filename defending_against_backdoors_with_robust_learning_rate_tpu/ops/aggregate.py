@@ -37,6 +37,19 @@ import jax.numpy as jnp
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 
 
+def rlr_from_sign_sum(sign_sum, threshold, server_lr):
+    """The RLR vote decision from a (raw or absolute) sign-sum array:
+    +server_lr per coordinate where |sum_k sign(u_k)| >= threshold, else
+    -server_lr (src/aggregation.py:48-54). THE single source of the vote
+    arithmetic — shared by the vmap tree path (`robust_lr`), the sharded
+    per-leaf psum paths (parallel/rounds.py) and the bucketed
+    reduce-scatter path, where `sign_sum` is the SCATTERED shard
+    (parallel/buckets.py) — so every layout thresholds identically.
+    `threshold` may be a traced scalar (the mask-aware scaled value)."""
+    return jnp.where(jnp.abs(sign_sum) >= threshold, server_lr,
+                     -server_lr).astype(jnp.float32)
+
+
 def robust_lr(stacked_updates, threshold, server_lr: float, mask=None):
     """Per-parameter learning-rate tree: +server_lr where the sign-agreement
     vote reaches `threshold`, else -server_lr (src/aggregation.py:48-54).
@@ -50,8 +63,8 @@ def robust_lr(stacked_updates, threshold, server_lr: float, mask=None):
         stacked_updates = masking.zero_masked(stacked_updates, mask)
 
     def leaf(u):
-        s = jnp.abs(jnp.sum(jnp.sign(u), axis=0))
-        return jnp.where(s >= threshold, server_lr, -server_lr).astype(jnp.float32)
+        return rlr_from_sign_sum(jnp.sum(jnp.sign(u), axis=0), threshold,
+                                 server_lr)
     return tree.map(leaf, stacked_updates)
 
 

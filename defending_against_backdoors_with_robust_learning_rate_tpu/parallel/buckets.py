@@ -1,0 +1,186 @@
+"""Bucketed flat-vector layout for pod-shape aggregation collectives.
+
+The leaf-wise aggregation plan in `parallel/rounds.py` issues one psum per
+parameter leaf (2L+2 = 18 collectives on the flagship CNN) — free on one
+chip where psums are memcpys, the wrong shape for a pod: Podracer
+(arXiv:2104.06272) makes device utilization the scaling signal and wants
+FEW, LARGE collectives so the interconnect runs at bandwidth instead of
+latency. This module is the layout half of that rework (`--agg_layout
+bucket`): flatten the update pytree ONCE into at most a few fixed-size
+buckets, run one `reduce-scatter` per bucket, compute the weighted
+average AND the RLR sign-vote on the scattered shard, and `all-gather`
+only the already-LR-scaled result.
+
+Layout rules (all static, computed at trace time from the leaf avals):
+
+- leaves are flattened in pytree order and concatenated into one flat
+  coordinate space of `total` real coordinates;
+- the flat space is padded up to ``n_buckets * bucket`` where ``bucket``
+  is divisible by the mesh size ``d`` — padding is EXPLICIT (zeros), and
+  every consumer masks it out of statistics via `shard_coord_index`;
+- ``n_buckets = ceil(total_bytes / BUCKET_BYTES)``: small models (the
+  flagship CNN) take ONE bucket; a model too big to stage as a single
+  flat copy splits into ~`BUCKET_BYTES` chunks so collective message
+  sizes stay bounded (and real pods can pipeline them).
+
+The layout is a pure function of (leaf shapes/dtypes, d, bucket bytes)
+and is memoized on exactly that key — the same aval signature that keys
+the AOT fingerprint (`utils/compile_cache.fingerprint`), so one layout
+serves every trace of a program family and can never drift from the
+banked executable's shapes.
+
+Donation safety: `flatten_stacked`/`flatten_tree` build NEW buffers
+(reshape+concat) and never alias their inputs, and `unflatten` returns
+slices of the gathered vector — a donated `params` buffer is only ever
+read leaf-wise on the `p + delta` tail, exactly like the leaf path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# per-bucket payload ceiling: one bucket for anything up to ResNet-9
+# scale (4.9M f32 params ~ 19 MiB -> 2 buckets), bounded message sizes
+# beyond. A power of two keeps the padded length friendly to the d-way
+# shard split at every topology in the contract matrix (1/8/16-way).
+BUCKET_BYTES = 16 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Static description of one flattened update space.
+
+    `shapes`/`sizes`/`offsets` describe the leaves in pytree order;
+    `total` is the real coordinate count, `padded = n_buckets * bucket`
+    the explicit-padding extent; `bucket % d == 0` always holds so the
+    per-bucket reduce-scatter shard is `bucket // d` on every device."""
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int
+    padded: int
+    n_buckets: int
+    bucket: int
+    d: int
+
+    @property
+    def shard(self) -> int:
+        """Per-bucket, per-device shard length of the scattered result."""
+        return self.bucket // self.d
+
+    @property
+    def device_len(self) -> int:
+        """Total scattered coordinates one device holds (all buckets)."""
+        return self.n_buckets * self.shard
+
+
+@functools.lru_cache(maxsize=64)
+def _layout(leaf_key: Tuple[Tuple[Tuple[int, ...], str], ...], d: int,
+            bucket_bytes: int) -> BucketLayout:
+    import math
+    shapes = tuple(s for s, _ in leaf_key)
+    sizes = tuple(math.prod(s) for s in shapes)
+    offsets, off = [], 0
+    for n in sizes:
+        offsets.append(off)
+        off += n
+    total = off
+    # 4 bytes/coord: the flat space is f32 regardless of leaf dtype (the
+    # aggregation arithmetic is f32 on the leaf path too)
+    n_buckets = max(1, -(-total * 4 // bucket_bytes))
+    bucket = -(-total // n_buckets)
+    bucket += -bucket % max(d, 1)            # divisible by the mesh size
+    return BucketLayout(shapes=shapes, sizes=sizes, offsets=tuple(offsets),
+                        total=total, padded=n_buckets * bucket,
+                        n_buckets=n_buckets, bucket=bucket, d=d)
+
+
+def layout_for_leaves(tree, d: int,
+                      bucket_bytes: int = 0) -> BucketLayout:
+    """Layout keyed by the UNSTACKED per-coordinate leaf shapes of
+    `tree` (aggregate/params-shaped pytree). `bucket_bytes` 0 = the
+    module default (resolved at call time so tests can shrink it to
+    force the multi-bucket path on tiny models)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    key = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+    return _layout(key, d, bucket_bytes or BUCKET_BYTES)
+
+
+def layout_for_stacked(tree, d: int,
+                       bucket_bytes: int = 0) -> BucketLayout:
+    """Layout for a pytree of `[mb, ...]` stacked update leaves: the
+    leading agent axis is stripped before keying, so the stacked and
+    aggregate views of the same model share one layout object."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    key = tuple((tuple(l.shape[1:]), str(l.dtype)) for l in leaves)
+    return _layout(key, d, bucket_bytes or BUCKET_BYTES)
+
+
+def flatten_stacked(layout: BucketLayout, tree) -> jnp.ndarray:
+    """[mb, ...] stacked leaves -> one [mb, padded] f32 matrix (explicit
+    zero padding on the tail). New buffers — never aliases the input."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    mb = leaves[0].shape[0]
+    flat = jnp.concatenate(
+        [l.reshape(mb, -1).astype(jnp.float32) for l in leaves], axis=1)
+    pad = layout.padded - layout.total
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat
+
+
+def flatten_tree(layout: BucketLayout, tree) -> jnp.ndarray:
+    """Aggregate-shaped pytree -> one [padded] f32 vector (zero-padded).
+    Used to route replicated per-leaf values (server noise) through the
+    scattered layout without changing their generation semantics."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in leaves])
+    pad = layout.padded - layout.total
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
+
+
+def unflatten(layout: BucketLayout, flat, treedef):
+    """[padded] (or longer; extra tail ignored) flat vector -> pytree of
+    aggregate-shaped f32 leaves, inverse of `flatten_tree`."""
+    leaves = [jax.lax.dynamic_slice_in_dim(flat, off, n, 0).reshape(shape)
+              for off, n, shape in zip(layout.offsets, layout.sizes,
+                                       layout.shapes, strict=True)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def device_shard(layout: BucketLayout, flat_1d, device_pos):
+    """This device's scattered coordinates of a replicated [padded]
+    vector: concat over buckets of the [shard] slice at `device_pos` —
+    the exact coordinates `lax.psum_scatter(..., tiled=True)` leaves on
+    that device. `device_pos` may be traced (lax.axis_index)."""
+    return jnp.concatenate([
+        jax.lax.dynamic_slice_in_dim(
+            flat_1d, b * layout.bucket + device_pos * layout.shard,
+            layout.shard, 0)
+        for b in range(layout.n_buckets)])
+
+
+def shard_coord_index(layout: BucketLayout, device_pos) -> jnp.ndarray:
+    """[device_len] global flat-coordinate index of this device's
+    scattered shard (all buckets concatenated). Compare against
+    `layout.total` to mask padding out of shard-local statistics."""
+    per_bucket = jnp.arange(layout.shard, dtype=jnp.int32)
+    return jnp.concatenate([
+        b * layout.bucket + device_pos * layout.shard + per_bucket
+        for b in range(layout.n_buckets)])
+
+
+def gathered_to_flat(layout: BucketLayout, gathered_rows) -> jnp.ndarray:
+    """[d, device_len] all-gathered per-device rows -> the replicated
+    [padded] flat vector. Device i's row holds its [shard] slice of every
+    bucket back-to-back, so the bucket-major reassembly is a transpose."""
+    rows = gathered_rows.reshape(layout.d, layout.n_buckets, layout.shard)
+    return jnp.transpose(rows, (1, 0, 2)).reshape(layout.padded)

@@ -43,7 +43,9 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import 
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     RFA_EPS, RFA_ITERS, agent_sq_dists, apply_aggregate, gaussian_noise_like,
-    sq_dist_accum, trmean_k)
+    rlr_from_sign_sum, sq_dist_accum, trmean_k)
+from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+    buckets)
 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.compat import (
     shard_map)
 from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
@@ -225,8 +227,7 @@ def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
     lr_leaves, agg_leaves, s_leaves = [], [], []
     for u in leaves:
         s = jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS)
-        lr_leaves.append(jnp.where(jnp.abs(s) >= thr, slr,
-                                   -slr).astype(jnp.float32))
+        lr_leaves.append(rlr_from_sign_sum(s, thr, slr))
         agg_leaves.append(jnp.sign(s))
         s_leaves.append(s)
     lr = jax.tree_util.tree_unflatten(treedef, lr_leaves)
@@ -261,10 +262,155 @@ def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None):
     lr_leaves, s_leaves = [], []
     for u in leaves:
         s = jnp.abs(jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS))
-        lr_leaves.append(jnp.where(s >= thr, slr, -slr).astype(jnp.float32))
+        lr_leaves.append(rlr_from_sign_sum(s, thr, slr))
         s_leaves.append(s)
     return (jax.tree_util.tree_unflatten(treedef, lr_leaves),
             jax.tree_util.tree_unflatten(treedef, s_leaves))
+
+
+def _bucket_applicable(cfg) -> bool:
+    """The bucketed reduce-scatter layout covers the psum-shaped rules
+    (weighted FedAvg and signSGD, RLR on or off — the paper's headline
+    configurations). The transpose rules (comed/trmean/krum) already run
+    few large collectives (all_to_all + all_gather) and keep their plan;
+    rfa's replicated Weiszfeld iterate keeps its per-iteration psums.
+    Diagnostics need the full lr tree materialized, which the scattered
+    vote never builds — `_build_sharded_body` refuses that combination
+    loudly rather than silently mixing layouts across snap rounds."""
+    return cfg.agg_layout == "bucket" and cfg.aggr in ("avg", "sign")
+
+
+class _BucketInfo:
+    """What the bucketed apply hands to telemetry: the post-noise/guard
+    aggregate tree (full level only — reassembled from the same
+    all_gather that carried the LR-scaled result), the globally-summed
+    vote/flip stats vector that rode that gather (obs/telemetry.py
+    shard_vote_stats; None when telemetry is off), and the real (unpadded)
+    coordinate count."""
+
+    def __init__(self, agg=None, stats=None, total_coords=0):
+        self.agg = agg
+        self.stats = stats
+        self.total_coords = total_coords
+
+
+def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
+                    mask_local=None, mask_full=None):
+    """avg/sign [+ RLR] aggregation on the bucketed flat layout
+    (parallel/buckets.py): ONE reduce-scatter per bucket of the stacked
+    partial sums (weighted sum and/or sign sum ride the SAME collective),
+    the masked weighted-average AND the RLR sign-vote computed on the
+    scattered shard, then ONE all_gather of the already-LR-scaled result.
+    Collectives on the flagship (1 bucket): reduce-scatter + all-gather
+    (+ the scalar weight-total psum for avg) — vs 2L+2 = 18 per-leaf
+    psums on the leaf layout.
+
+    Per-coordinate arithmetic is IDENTICAL to the leaf path (the flatten
+    is a relayout, the local partial sums run over the same mb rows in
+    the same order, noise is generated per leaf with the same key split,
+    the empty-electorate guard multiplies the same replicated flag), so
+    bucket-vs-leaf parity is pinned bitwise in fp32
+    (tests/test_bucket_parity.py). Padding coordinates are explicit
+    zeros: they vote margin 0 (=> lr -slr), aggregate 0, and are masked
+    out of every statistic via `shard_coord_index`.
+
+    Returns (new_params, _BucketInfo)."""
+    ax = AGENTS_AXIS
+    masked = mask_local is not None
+    rlr = cfg.robustLR_threshold > 0
+    thr = float(cfg.robustLR_threshold)
+    if masked:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+            masking)
+        updates = masking.zero_masked(updates, mask_local)
+        if rlr:
+            thr = masking.rlr_threshold(cfg, mask_full)
+    slr = cfg.effective_server_lr
+    layout = buckets.layout_for_stacked(updates, d)
+    flat = buckets.flatten_stacked(layout, updates)       # [mb, padded]
+
+    # the full level reads vote margins even without RLR (the leaf path
+    # budgets its own per-leaf psums for that; here the sign sums ride
+    # the one reduce-scatter for free)
+    want_sign = rlr or cfg.aggr == "sign" or cfg.telemetry == "full"
+    rows = []
+    total = None
+    if cfg.aggr == "avg":
+        w = sizes.astype(jnp.float32)
+        if masked:
+            w = jnp.where(mask_local, w, 0.0)
+        total = jax.lax.psum(jnp.sum(w), ax)              # scalar psum
+        rows.append(jnp.sum(flat * w[:, None], axis=0))
+    if want_sign:
+        rows.append(jnp.sum(jnp.sign(flat), axis=0))
+    stacked = jnp.stack(rows)                             # [r, padded]
+    # one reduce-scatter per bucket; both quantities share each collective
+    scat = jnp.concatenate([
+        jax.lax.psum_scatter(
+            stacked[:, b * layout.bucket:(b + 1) * layout.bucket],
+            ax, scatter_dimension=1, tiled=True)
+        for b in range(layout.n_buckets)], axis=1)        # [r, device_len]
+
+    sign_s = scat[-1] if want_sign else None
+    if cfg.aggr == "avg":
+        agg_s = scat[0] / total
+    else:
+        agg_s = jnp.sign(sign_s)
+    if cfg.noise > 0:
+        # generated per leaf from the identical key split as the leaf
+        # path (gaussian_noise_like over the same tree structure), then
+        # relayed out through the flat space — bitwise the same noise
+        noise = gaussian_noise_like(params, noise_key,
+                                    cfg.noise * cfg.clip)
+        pos = jax.lax.axis_index(ax)
+        agg_s = agg_s + buckets.device_shard(
+            layout, buckets.flatten_tree(layout, noise), pos)
+    if masked:
+        agg_s = masking.guard_empty(agg_s, mask_full)
+    if rlr:
+        lr_s = rlr_from_sign_sum(sign_s, thr, slr)
+    else:
+        lr_s = None
+    delta_s = (lr_s if lr_s is not None else slr) * agg_s
+
+    # ONE all_gather carries the LR-scaled result — plus, under
+    # telemetry, the unscaled aggregate (full: the cosine split needs
+    # the replicated agg tree) and the tiny vote/flip stats vector
+    # (basic/full: summed across devices after the gather), so telemetry
+    # adds ZERO collectives here
+    payload = [delta_s]
+    stats_len = 0
+    if cfg.telemetry == "full":
+        payload.append(agg_s)
+    if cfg.telemetry != "off":
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            telemetry)
+        pos = jax.lax.axis_index(ax)
+        real = buckets.shard_coord_index(layout, pos) < layout.total
+        stats = telemetry.shard_vote_stats(cfg, sign_s, real, lr_s,
+                                           cfg.agents_per_round)
+        if stats is not None:
+            payload.append(stats)
+            stats_len = stats.shape[0]
+    gathered = jax.lax.all_gather(
+        jnp.concatenate(payload) if len(payload) > 1 else payload[0],
+        ax, axis=0, tiled=True).reshape(d, -1)
+
+    dl = layout.device_len
+    treedef = jax.tree_util.tree_structure(params)
+    delta = buckets.unflatten(
+        layout, buckets.gathered_to_flat(layout, gathered[:, :dl]),
+        treedef)
+    new_params = tree.astype(
+        tree.map(lambda p, dlt: p + dlt, params, delta), jnp.float32)
+    info = _BucketInfo(total_coords=layout.total)
+    if cfg.telemetry == "full":
+        info.agg = buckets.unflatten(
+            layout, buckets.gathered_to_flat(layout, gathered[:, dl:2 * dl]),
+            treedef)
+    if stats_len:
+        info.stats = jnp.sum(gathered[:, -stats_len:], axis=0)
+    return new_params, info
 
 
 def _sharded_pallas_apply(params, updates, sizes, cfg):
@@ -342,6 +488,16 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     d = mesh.devices.size
     assert m % d == 0, f"agents_per_round={m} not divisible by mesh size {d}"
     mb = m // d
+    if cfg.agg_layout not in ("leaf", "bucket"):
+        raise ValueError(f"agg_layout must be 'leaf' or 'bucket', got "
+                         f"{cfg.agg_layout!r}")
+    if cfg.agg_layout == "bucket" and cfg.diagnostics:
+        # the scattered vote never materializes the full lr tree the
+        # diagnostics extras (lr_flat) read; mixing layouts between snap
+        # and off-snap rounds would silently compare different programs
+        raise ValueError(
+            "--agg_layout bucket does not support --diagnostics (the "
+            "lr tree is never materialized); use --agg_layout leaf")
 
     def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
         # trailing replicated [m] inputs, in order: corrupt flags (faults /
@@ -396,12 +552,22 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
             return new_params, loss, {}
         sign_sums = None
+        bucket_info = None
         with jax.named_scope("aggregate_rlr"):
-            if cfg.robustLR_threshold > 0 and cfg.aggr == "sign":
+            if _bucket_applicable(cfg):
+                # pod-shape plan: per-bucket reduce-scatter + one
+                # all_gather of the LR-scaled result, vote + average on
+                # the scattered shard (parallel/buckets.py)
+                lr = agg = None
+                new_params, bucket_info = _bucketed_apply(
+                    params, updates, szs, cfg, noise_key, d,
+                    mask_local, mask_full)
+            elif cfg.robustLR_threshold > 0 and cfg.aggr == "sign":
                 # vote + aggregate share one sign-sum psum per leaf (the
                 # CSE XLA was measured not to do — see _sharded_sign_shared)
                 lr, agg, sign_sums = _sharded_sign_shared(
                     updates, cfg, noise_key, mask_local, mask_full)
+                new_params = apply_aggregate(params, lr, agg)
             else:
                 if cfg.robustLR_threshold > 0:
                     lr, sign_sums = _sharded_robust_lr(updates, cfg,
@@ -411,7 +577,7 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                     lr = cfg.effective_server_lr
                 agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
                                          mask_local, mask_full)
-            new_params = apply_aggregate(params, lr, agg)
+                new_params = apply_aggregate(params, lr, agg)
         loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
         extras = {}
         if faults_on:
@@ -427,14 +593,24 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
         if cfg.telemetry != "off":
             from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
                 telemetry)
-            # sign_sums: the vote's per-leaf psum results, so full
-            # telemetry's margin histogram re-reads the existing
-            # collective instead of duplicating it per leaf
-            extras.update(telemetry.compute_sharded(
-                cfg, updates,
-                lr if cfg.robustLR_threshold > 0 else None, agg,
-                AGENTS_AXIS, mask_local=mask_local, mask_full=mask_full,
-                corrupt_full=corrupt_full, sign_sums=sign_sums))
+            if bucket_info is not None:
+                # the vote/flip stats and (full) the aggregate tree rode
+                # the bucketed result all_gather — zero extra psums, the
+                # leaf path's sign_sums sharing discipline on the new
+                # layout
+                extras.update(telemetry.compute_sharded_bucket(
+                    cfg, updates, bucket_info, AGENTS_AXIS,
+                    mask_local=mask_local, mask_full=mask_full,
+                    corrupt_full=corrupt_full))
+            else:
+                # sign_sums: the vote's per-leaf psum results, so full
+                # telemetry's margin histogram re-reads the existing
+                # collective instead of duplicating it per leaf
+                extras.update(telemetry.compute_sharded(
+                    cfg, updates,
+                    lr if cfg.robustLR_threshold > 0 else None, agg,
+                    AGENTS_AXIS, mask_local=mask_local, mask_full=mask_full,
+                    corrupt_full=corrupt_full, sign_sums=sign_sums))
         if cfg.diagnostics:
             from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
                 per_agent_norms)

@@ -304,6 +304,9 @@ class RoundEngine:
             print(f"[mesh] {n_mesh} devices on the `agents` axis "
                   f"({cfg.agents_per_round // n_mesh} agents/device), "
                   f"{jax.process_count()} process(es)")
+            from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                multihost as mh)
+            print(f"[agg] {mh.agg_plan_note(cfg, params, mesh)}")
             round_fn = make_sharded_round_fn(plain_cfg, model, norm, mesh,
                                              *arrays)
             diag_round_fn = (make_sharded_round_fn(cfg, model, norm, mesh,
@@ -359,6 +362,9 @@ class RoundEngine:
                     print(f"[mesh] {n_mesh} devices on the `agents` axis "
                           f"({m // n_mesh} cohort members/device), "
                           f"cohort-sampled")
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.parallel import (
+                        multihost as mh)
+                    print(f"[agg] {mh.agg_plan_note(cfg, params, mesh)}")
                     agents_sharding = NamedSharding(mesh, P(AGENTS_AXIS))
                     block_sharding = NamedSharding(mesh,
                                                    P(None, AGENTS_AXIS))

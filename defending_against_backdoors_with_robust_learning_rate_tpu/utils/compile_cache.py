@@ -30,7 +30,14 @@ Program families (the manifest vocabulary; see `plan_programs`):
     chained_cohort /        in-program seeded cohort over the client
     round_sharded_cohort    bank (data/bank.py + data/cohort.py)
     round_sharded /         shard_map variants (parallel/rounds.py) —
-    chained_sharded         adopted at runtime, banked best-effort
+    chained_sharded         adopted at runtime, banked best-effort;
+                            `--agg_layout bucket` (ISSUE 8) swaps their
+                            aggregation plan to the bucketed
+                            reduce-scatter program — same family names,
+                            distinct fingerprints (agg_layout is a
+                            program field), and the analysis passes plan
+                            them per topology through
+                            `plan_sharded_programs`
     eval_val / eval_poison  the two eval-set program instances
 
 Every entry is a pair of files in `<root>/aot/`: `<family>-<fp>.jex`
@@ -98,6 +105,12 @@ EXCLUDED_FIELDS = frozenset({
     # (cohort_seed/cohort_size and the partitioner fields by contrast DO
     # shape programs or data and are fingerprinted)
     "cohort_sampled", "bank_dir", "bank_shard_clients",
+    # NOT here: `agg_layout` (ISSUE 8). It selects the sharded
+    # aggregation program (per-leaf psums vs bucketed reduce-scatter,
+    # parallel/rounds.py reads it at trace time), so it must stay in the
+    # fingerprint even though the sharded families are never banked —
+    # the same rule as `telemetry`: a traced read makes it program
+    # provenance, and the audit fails closed on excluding it.
 })
 
 # families built from cfg.replace(diagnostics=False) in the driver; their

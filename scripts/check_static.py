@@ -6,15 +6,18 @@ Runs, in order, with a non-zero exit on any finding:
 1. AST rules + fingerprint audit (pure AST + config import — fast, no
    programs built);
 2. jaxpr contracts for the single-device (vmap) families;
-3. jaxpr contracts for the shard_map families on a faked 8-device CPU
-   mesh (the tests/conftest.py trick), including the compiled-HLO
-   collective ceilings when --compiled (the CI default) is given.
+3. jaxpr contracts for the shard_map families at EVERY topology in
+   contracts.TOPOLOGIES (1/8/16-way `agents` meshes, faked CPU devices —
+   the tests/conftest.py trick at pod width), including the compiled-HLO
+   collective ceilings when --compiled (the CI default) is given — so
+   the gate judges the leaf AND bucketed aggregation plans at pod
+   shapes, not just the 8-way CI mesh.
 
 Equivalent to:
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=16 JAX_PLATFORMS=cpu \
     python -m defending_against_backdoors_with_robust_learning_rate_tpu.analysis \
-        --sharded --compiled
+        --sharded --compiled --topologies 1,8,16
 
 but sets the env itself (before jax initializes) so it works as a bare
 `python scripts/check_static.py` anywhere.
@@ -39,10 +42,26 @@ def main() -> int:
                          "diffing against it")
     args = ap.parse_args()
 
+    # fake enough CPU devices for the widest topology in the contract
+    # matrix (must happen before jax initializes)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.contracts import (
+        TOPOLOGIES)
+    import re
+    widest = max(TOPOLOGIES)
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+            flags + f" --xla_force_host_platform_device_count={widest}"
+        ).strip()
+    elif int(m.group(1)) < widest:
+        # a pre-existing smaller count (e.g. the 8 this script used to
+        # document) cannot trace the pod-shape topologies — widen it
+        # rather than dying in jaxpr_lint's explicit-topology check
+        print(f"[check_static] raising faked device count "
+              f"{m.group(1)} -> {widest} (pod-shape topologies)")
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={widest}")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.__main__ import (
@@ -50,7 +69,8 @@ def main() -> int:
 
     if args.fast:
         return analysis_main(["--rules", "ast,audit"])
-    argv = ["--rules", "ast,audit,jaxpr", "--sharded"]
+    argv = ["--rules", "ast,audit,jaxpr", "--sharded",
+            "--topologies", ",".join(str(d) for d in TOPOLOGIES)]
     if not args.no_compiled:
         argv.append("--compiled")
     if args.write_baseline:

@@ -561,6 +561,10 @@ def test_serve_crash_exact_resume_vmap(tmp_path, svc_cache):
     assert status["phase"] == "done"
 
 
+@pytest.mark.slow  # ~30s; slow-gated (ISSUE 8 budget). Cheap twin in
+# tier-1: test_serve_crash_exact_resume_vmap drills the identical
+# recovery protocol; the sharded round body itself is parity-pinned by
+# test_parallel + test_bucket_parity.
 def test_serve_crash_exact_resume_sharded(tmp_path, svc_cache):
     """The same drill over the 8-device shard_map path (faked CPU mesh):
     churn + masked collectives + crash recovery compose."""
