@@ -220,6 +220,18 @@ def main():
                     help="samples per client on the ladder rungs (0 = "
                          "auto clamp; the SAME value lands on every rung, "
                          "so rung rounds/sec are compute-comparable)")
+    ap.add_argument("--train_layout", choices=("vmap", "megabatch", "both"),
+                    default="",
+                    help="A/B the local-training compute layout (ISSUE "
+                         "10, fl/client.py): vmap = per-client batched "
+                         "steps; megabatch = the client axis folded into "
+                         "one [m*bs, ...] pass with client-segmented "
+                         "loss/grad reductions. 'both' measures each "
+                         "layout's steady rounds/sec + analytic-FLOP "
+                         "MFU (train_layout_ab in the output JSON; the "
+                         "headline value stays the vmap number); a "
+                         "single value re-runs the headline under that "
+                         "layout")
     ap.add_argument("--agg_layout", choices=("leaf", "bucket", "both"),
                     default="",
                     help="A/B the sharded aggregation collective shape "
@@ -349,6 +361,10 @@ def main():
              "compile_cache_dir": args.compile_cache_dir}
     if args.dtype:
         extra["dtype"] = args.dtype
+    if args.train_layout in ("vmap", "megabatch"):
+        # a single layout re-points the HEADLINE; 'both' keeps the vmap
+        # headline and adds the A/B block below
+        extra["train_layout"] = args.train_layout
     if cpu_fallback:
         extra["data_dir"] = "/nonexistent_use_synthetic_reduced"
     # BASELINE.json configs[1] (fmnist flagship) or configs[3] (resnet9,
@@ -753,6 +769,68 @@ def main():
                 f"{rss.get('host_peak_rss_bytes', 0) / 2**30:.2f} GiB "
                 f"peak")
 
+    # analytic performance anatomy (ISSUE 10): FLOPs/round from the model
+    # registry's arithmetic — no compile, works on every backend, so the
+    # MFU trajectory is tracked on CPU before a TPU session ever runs.
+    # One fwd+bwd step ~ 3x the forward (registry docstring convention).
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        flops_per_example)
+    peak = peak_tflops(device.device_kind)
+    analytic_round = None
+    fwd_flops = flops_per_example(cfg.data, cfg.model_arch,
+                                  fed.train.images.shape[2:], cfg.n_classes)
+    if fwd_flops:
+        nb_an = fed.train.images.shape[1] // cfg.bs
+        analytic_round = (cfg.agents_per_round * cfg.local_ep * nb_an
+                          * cfg.bs * 3.0 * fwd_flops)
+        log(f"[bench] analytic {analytic_round/1e12:.2f} TFLOP/round "
+            f"({cfg.agents_per_round}x{cfg.local_ep}x{nb_an}x{cfg.bs} "
+            f"examples, 3x fwd)")
+
+    def layout_row(r, c_s):
+        """Per-layout A/B record: throughput + the analytic-FLOP MFU
+        fields (mfu only when the chip's peak is known — on CPU the
+        trackable trajectory number is analytic_tflops_per_sec)."""
+        row = {"rounds_per_sec": round(r, 4), "compile_s": round(c_s, 1)}
+        if analytic_round:
+            tps = analytic_round * r / 1e12
+            row["analytic_tflops_per_sec"] = round(tps, 3)
+            if peak:
+                row["mfu"] = round(tps / peak, 4)
+        return row
+
+    layout_ab_out = None
+    if args.train_layout == "both":
+        # train-layout A/B (ISSUE 10): the SAME flagship config through
+        # the chained round program under each local-training layout —
+        # the vmap headline above is reused as its own cell, megabatch
+        # measured fresh (distinct chained_mb program family, its own
+        # AOT entry)
+        hb.update(phase="train_layout_ab", force=True)
+        # the megabatch cell gets ITS OWN capture dir: the headline's
+        # --profile_rounds trace above profiled the vmap program, and an
+        # attribution labeled megabatch but measured on vmap would lie
+        # to the r11 MFU judgment
+        mb_profile = (args.profile_trace_dir + "_mb"
+                      if args.profile_rounds > 0 else None)
+        _, r_mb, c_mb, _ = measure(cfg.replace(train_layout="megabatch"),
+                                   label="[train_layout megabatch]",
+                                   profile_dir=mb_profile)
+        layout_ab_out = {"vmap": layout_row(rounds_per_sec, compile_s),
+                         "megabatch": layout_row(r_mb, c_mb),
+                         "megabatch_vs_vmap": round(
+                             r_mb / rounds_per_sec, 4)}
+        if mb_profile:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                attribution as _attr)
+            mb_attr = _attr.attribute(mb_profile)
+            if mb_attr is not None:
+                # the vmap layout's attribution is the top-level
+                # `attribution` field (the headline capture)
+                layout_ab_out["megabatch"]["attribution"] = mb_attr
+        log(f"[bench] megabatch/vmap throughput ratio: "
+            f"{layout_ab_out['megabatch_vs_vmap']:.3f}x")
+
     # performance anatomy (VERDICT r2 weak #1): FLOPs/round from XLA's own
     # cost analysis of the compiled client step, and MFU against the chip's
     # bf16 peak — "actually fast, or just correct?" on the record
@@ -768,7 +846,7 @@ def main():
             flops_round = (cfg.agents_per_round * cfg.local_ep * nb
                            * step_flops)
             tflops_sec = flops_round * rounds_per_sec / 1e12
-            peak = peak_tflops(device.device_kind)
+            # `peak` computed once beside the analytic block above
             log(f"[bench] {flops_round/1e12:.2f} TFLOP/round (XLA cost "
                 f"analysis, {cfg.agents_per_round}x{cfg.local_ep}x{nb} "
                 f"steps) -> {tflops_sec:.1f} TFLOP/s")
@@ -939,11 +1017,24 @@ def main():
         # only when a comparable measured baseline exists (fmnist config);
         # resnet9 has no reference counterpart, so no 1.0x placeholder
         out["vs_baseline"] = round(vs_baseline, 2)
+    out["train_layout"] = cfg.train_layout
     if flops_round is not None:
         out["tflop_per_round"] = round(flops_round / 1e12, 4)
         out["tflops_per_sec"] = round(tflops_sec, 2)
+    if analytic_round is not None:
+        # the compile-free MFU trajectory (ISSUE 10): analytic FLOPs from
+        # the model registry, trackable on CPU before any TPU session
+        out["analytic_tflop_per_round"] = round(analytic_round / 1e12, 4)
+        out["analytic_tflops_per_sec"] = round(
+            analytic_round * rounds_per_sec / 1e12, 3)
+        if mfu is None and peak:
+            # cost analysis unavailable (some backends) — the analytic
+            # count still yields the MFU figure
+            mfu = analytic_round * rounds_per_sec / 1e12 / peak
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    if layout_ab_out is not None:
+        out["train_layout_ab"] = layout_ab_out
     if faults_out is not None:
         out["faults"] = faults_out
     if telemetry_out is not None:

@@ -103,6 +103,24 @@ DONATED_CALLS: Dict[str, Tuple[int, ...]] = {
     "host_chained_fn": (0,),
 }
 
+# Program families whose params argument MUST be donated (position 0):
+# the chained lax.scan blocks are the throughput hot path, and without
+# donation every dispatched block would hold two full parameter buffers
+# (and XLA may insert a copy for the carry). The donation-audit pin
+# (ISSUE 10, tests/test_megabatch.py::test_chained_families_donate_params)
+# lowers each family through the compile-cache planners and asserts the
+# StableHLO input-output aliasing attribute on arg 0 — a regression (a
+# refactor dropping donate_argnums) fails tier-1/CI. The per-round
+# families deliberately do NOT donate: the diagnostics snap path reads
+# prev_params after the call, parity tests dispatch several programs on
+# one buffer, and the service supervisor may retry a dispatch whose
+# donated input a partially-executed call already consumed.
+DONATED_FAMILIES: Tuple[str, ...] = (
+    "chained", "chained_mb", "chained_host", "chained_host_mb",
+    "chained_cohort", "chained_cohort_mb",
+    "chained_sharded", "chained_sharded_mb",
+)
+
 # --------------------------------------------------------------------------
 # Jaxpr contracts (analysis/jaxpr_lint.py)
 # --------------------------------------------------------------------------
@@ -362,6 +380,51 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         cfg_overrides={**bucket, "cohort_sampled": "on"},
         collective_budget=dict(rs_budget),
         hlo_all_reduce_max=2 + spmd_overhead)
+
+    # megabatch training layout (ISSUE 10, fl/client.py): folding the
+    # client axis into the batch is a COMPUTE-layout change only — the
+    # acceptance claim is the IDENTICAL collective plan as the vmap twin
+    # of every family (the fold happens inside each device's local
+    # block, before any aggregation collective). The specs below pin
+    # that at jaxpr and compiled-HLO level across the vmap family (zero
+    # collectives), the flagship sharded plan (2L+2 psums), the faults
+    # variant (+ exactly the one [m]-bit validation all_gather), the
+    # chained scan, the cohort family, and the bucketed reduce-scatter
+    # plan (4 collectives) — megabatch composes with the pod shape.
+    mb = {"train_layout": "megabatch"}
+    specs["vmap_rlr_avg_mb"] = CheckSpec(
+        name="vmap_rlr_avg_mb", family="round_mb", sharded=False,
+        cfg_overrides=dict(mb), collective_budget=dict(zero))
+    specs["sharded_rlr_avg_mb"] = CheckSpec(
+        name="sharded_rlr_avg_mb", family="round_sharded_mb",
+        sharded=True, cfg_overrides=dict(mb),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_mb_faults"] = CheckSpec(
+        name="sharded_rlr_avg_mb_faults", family="round_sharded_mb",
+        sharded=True,
+        cfg_overrides={**mb, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_chained_rlr_avg_mb"] = CheckSpec(
+        name="sharded_chained_rlr_avg_mb", family="chained_sharded_mb",
+        sharded=True, cfg_overrides={**mb, "chain": 2, "snap": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_mb"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_mb", family="round_sharded_mb",
+        sharded=True, cfg_overrides={**mb, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_avg_mb_cohort"] = CheckSpec(
+        name="sharded_rlr_avg_mb_cohort",
+        family="round_sharded_cohort_mb", sharded=True,
+        cfg_overrides={**mb, "cohort_sampled": "on"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
 
     # cohort-sampled population axis (ISSUE 7, data/cohort.py): the
     # in-program cohort draw + active mask are replicated computations

@@ -82,6 +82,25 @@ class Config:
                                     # shape). leaf stays the default until
                                     # the TPU A/B lands (bench.py
                                     # --agg_layout)
+    train_layout: str = "vmap"      # vmap | megabatch — local-training
+                                    # compute layout (fl/client.py):
+                                    # vmap = per-client [bs, ...] steps
+                                    # batched by jax.vmap (the historical
+                                    # path); megabatch = the client axis
+                                    # folds into the batch — one
+                                    # [m*bs, ...] gather + normalize
+                                    # pass per minibatch step, step
+                                    # masks folded into per-client
+                                    # segment weights, the parameter
+                                    # chains advancing as one stacked
+                                    # [m, ...] tree (grads from the
+                                    # client-batched backward — see
+                                    # fl/client.py for why not a single
+                                    # grad-of-vmap). Parity is ulp-bounded in
+                                    # f32 (tests/test_megabatch.py);
+                                    # collective plan unchanged. vmap
+                                    # stays the default until the TPU
+                                    # A/B lands (bench.py --train_layout)
     chain: int = 1                  # rounds fused per dispatch via lax.scan
                                     # (capped at `snap`; >1 kills per-round
                                     # host dispatch overhead, bit-identical)
@@ -350,6 +369,13 @@ FIELD_PROVENANCE = {
                                   # collective plan (per-leaf psums vs
                                   # bucketed reduce-scatter) — a traced
                                   # program difference
+    "train_layout": "program",    # selects the local-training compute
+                                  # layout (vmapped per-client steps vs
+                                  # the megabatched [m*bs] fold) — a
+                                  # traced program difference; the
+                                  # fingerprint keys the RESOLVED layout
+                                  # (compile_cache.resolved_train_layout
+                                  # normalizes the --diagnostics degrade)
     "chain": "shape",             # round_ids aval pins the block length
     "host_prefetch": "runtime",
     "host_sampled": "runtime",    # selects the family; family names key
@@ -497,6 +523,16 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                         "the LR-scaled result with the RLR vote computed "
                         "on the scattered shard (pod shape, "
                         "parallel/buckets.py)")
+    p.add_argument("--train_layout", choices=("vmap", "megabatch"),
+                   default=d.train_layout,
+                   help="local-training compute layout: vmap = per-client "
+                        "[bs, ...] steps batched by jax.vmap; megabatch = "
+                        "fold the client axis into the batch — one "
+                        "[m*bs, ...] pass per minibatch step with a "
+                        "client-segmented loss/grad reduction "
+                        "(fl/client.py; ulp-bounded parity, identical "
+                        "collective plan). Degrades to vmap under "
+                        "--diagnostics")
     p.add_argument("--chain", type=int, default=d.chain,
                    help="rounds fused into one compiled lax.scan dispatch "
                         "(capped at --snap so eval cadence is unchanged)")

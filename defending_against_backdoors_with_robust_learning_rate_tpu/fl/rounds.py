@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
-    make_local_train)
+    make_local_train, make_local_train_megabatch)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import loops
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     aggregate_updates, apply_aggregate, robust_lr)
@@ -87,22 +87,45 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
     per-agent argument."""
     extra = () if ep_budget is None else (ep_budget,)
     vt = jax.vmap(local_train, in_axes=(None,) + (0,) * (4 + len(extra)))
+    return _run_chunked(vt, params, imgs, lbls, sizes, keys, chunk, extra)
+
+
+def megabatch_agents(mb_train, params, imgs, lbls, sizes, keys,
+                     chunk: int = 0, ep_budget=None):
+    """Run the megabatched block trainer (fl/client.py,
+    `--train_layout megabatch`) over the [m, ...] client block,
+    optionally in sequential chunks of `chunk` clients — the same HBM
+    lever (and the same divisibility rule) as `vmap_agents`: each chunk
+    group megabatches its own [chunk*bs, ...] fold, so peak activation
+    memory scales with `chunk` while results stay independent of the
+    chunking."""
+    extra = () if ep_budget is None else (ep_budget,)
+    return _run_chunked(mb_train, params, imgs, lbls, sizes, keys, chunk,
+                        extra)
+
+
+def _run_chunked(block_fn, params, imgs, lbls, sizes, keys, chunk, extra):
+    """The chunk-scan scaffold shared by BOTH training layouts:
+    `block_fn(params, imgs, lbls, sizes, keys, *extra)` over the whole
+    [m, ...] block, or over sequential [chunk, ...] groups — one policy
+    (divisor rule, CPU unroll cap) so the layouts can never drift."""
     m = imgs.shape[0]
     if 0 < chunk < m and m % chunk != 0:
-        # falling back to the full vmap would reproduce the exact
+        # falling back to the full block would reproduce the exact
         # compile-time OOM this flag exists to prevent — fail loudly
         raise ValueError(
             f"--agent_chunk {chunk} does not divide the agent block of {m} "
-            f"(per-device agent count); pick a divisor or 0 for full vmap")
+            f"(per-device agent count); pick a divisor or 0 for the full "
+            f"block")
     if chunk <= 0 or chunk >= m:
-        return vt(params, imgs, lbls, sizes, keys, *extra)
+        return block_fn(params, imgs, lbls, sizes, keys, *extra)
     nc = m // chunk
 
     def resh(a):
         return a.reshape((nc, chunk) + a.shape[1:])
 
     def body(carry, args):
-        return carry, vt(params, *args)
+        return carry, block_fn(params, *args)
 
     # routed through maybe_unrolled_scan: XLA:CPU executes convs inside
     # while-loops via a slow reference path (ops/loops.py), so short chunk
@@ -115,8 +138,39 @@ def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
         losses.reshape(m))
 
 
+def make_block_trainer(model, cfg, normalize):
+    """The layout-dispatched client-block trainer (ISSUE 10):
+    train_block(params, imgs, lbls, sizes, keys, chunk=0, ep_budget=None)
+    -> (updates [m, ...]-stacked, losses [m]).
+
+    `vmap` (default) batches the per-client local_train with jax.vmap;
+    `megabatch` folds the client axis into the batch
+    (fl/client.make_local_train_megabatch). Selection consults
+    compile_cache.resolved_train_layout — the single source that also
+    degrades megabatch to vmap under --diagnostics — so every round
+    builder (vmap/sharded/host/cohort x per-round/chained) picks the
+    layout through one door."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    if compile_cache.resolved_train_layout(cfg) == "megabatch":
+        mb_train = make_local_train_megabatch(model, cfg, normalize)
+
+        def train_block(params, imgs, lbls, sizes, keys, chunk=0,
+                        ep_budget=None):
+            return megabatch_agents(mb_train, params, imgs, lbls, sizes,
+                                    keys, chunk, ep_budget=ep_budget)
+        return train_block
+    local_train = make_local_train(model, cfg, normalize)
+
+    def train_block(params, imgs, lbls, sizes, keys, chunk=0,
+                    ep_budget=None):
+        return vmap_agents(local_train, params, imgs, lbls, sizes, keys,
+                           chunk, ep_budget=ep_budget)
+    return train_block
+
+
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
-                local_train, cfg, corrupt_flags=None, churn_active=None):
+                train_block, cfg, corrupt_flags=None, churn_active=None):
     """Shared round body: vmapped local training + aggregation + update.
 
     With faults configured (cfg.faults_enabled) the round additionally
@@ -144,7 +198,7 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         if cfg.straggler_rate > 0:
             ep_budget = draw.ep_budget
     with jax.named_scope("local_train"):
-        updates, losses = vmap_agents(local_train, params, imgs, lbls, sizes,
+        updates, losses = train_block(params, imgs, lbls, sizes,
                                       agent_keys, cfg.agent_chunk,
                                       ep_budget=ep_budget)
     mask = None
@@ -270,7 +324,7 @@ def _make_sample_step(cfg, model, normalize):
     closed-over arrays into the lowered HLO as dense constants (measured
     ~1 GiB of StableHLO for the fedemnist stacks, rejected by remote
     compile services and re-shipped on every compile)."""
-    local_train = make_local_train(model, cfg, normalize)
+    train_block = make_block_trainer(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
 
     def body(params, key, rnd, images, labels, sizes):
@@ -292,7 +346,7 @@ def _make_sample_step(cfg, model, normalize):
                 churn_active = churn_mod.active_slots(cfg, sampled, rnd)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
-            local_train=local_train, cfg=cfg,
+            train_block=train_block, cfg=cfg,
             corrupt_flags=(sampled < cfg.num_corrupt
                            if want_flags else None),
             churn_active=churn_active)
@@ -333,9 +387,13 @@ def make_round_fn(cfg, model, normalize, images, labels, sizes):
 
     images/labels/sizes are the full K-agent stacked arrays (jnp, on device).
     """
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
     return bind_data(jax.jit(_make_sample_step(cfg, model, normalize)),
                      (images, labels, sizes),
-                     family="round_diag" if cfg.diagnostics else "round")
+                     family=("round_diag" if cfg.diagnostics
+                             else "round"
+                             + compile_cache.family_suffix(cfg)))
 
 
 def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
@@ -350,9 +408,13 @@ def make_chained_round_fn(cfg, model, normalize, images, labels, sizes):
     info leaves are stacked per-round ([n_chain, ...]). Diagnostics extras are
     not supported here (the driver runs diagnostic snap rounds unchained).
     """
-    return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
-                                          model, normalize),
-                        (images, labels, sizes))
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    plain = cfg.replace(diagnostics=False)
+    return make_chained(_make_sample_step(plain, model, normalize),
+                        (images, labels, sizes),
+                        family="chained"
+                        + compile_cache.family_suffix(plain))
 
 
 def make_host_step(cfg, model, normalize, take_flags=None):
@@ -376,7 +438,7 @@ def make_host_step(cfg, model, normalize, take_flags=None):
         raise ValueError(
             "client churn (--churn_available < 1) is not supported in "
             "host-sampled mode; run device-resident (--host_sampled off)")
-    local_train = make_local_train(model, cfg, normalize)
+    train_block = make_block_trainer(model, cfg, normalize)
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
 
@@ -385,7 +447,7 @@ def make_host_step(cfg, model, normalize, take_flags=None):
             k_train, k_noise = jax.random.split(key)
             new_params, train_loss, extras = _round_core(
                 params, k_train, k_noise, imgs, lbls, sizes,
-                local_train=local_train, cfg=cfg,
+                train_block=train_block, cfg=cfg,
                 corrupt_flags=corrupt_flags)
             return new_params, {"train_loss": train_loss, **extras}
         return step
@@ -394,7 +456,7 @@ def make_host_step(cfg, model, normalize, take_flags=None):
         k_train, k_noise = jax.random.split(key)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, sizes,
-            local_train=local_train, cfg=cfg)
+            train_block=train_block, cfg=cfg)
         return new_params, {"train_loss": train_loss, **extras}
 
     return step
@@ -485,7 +547,7 @@ def make_cohort_step(cfg, model, normalize):
     are excluded from aggregation arithmetically, like dropped clients."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
         cohort as cohort_mod)
-    local_train = make_local_train(model, cfg, normalize)
+    train_block = make_block_trainer(model, cfg, normalize)
     want_flags = host_takes_flags(cfg)
 
     def step(params, key, rnd, imgs, lbls, sizes):
@@ -494,7 +556,7 @@ def make_cohort_step(cfg, model, normalize):
         k_train, k_noise = jax.random.split(key)
         new_params, train_loss, extras = _round_core(
             params, k_train, k_noise, imgs, lbls, sizes,
-            local_train=local_train, cfg=cfg,
+            train_block=train_block, cfg=cfg,
             corrupt_flags=((ids < cfg.num_corrupt) & active
                            if want_flags else None),
             churn_active=active)

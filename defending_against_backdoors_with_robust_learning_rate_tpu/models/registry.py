@@ -40,3 +40,49 @@ def init_params(model, image_shape, key=None, batch: int = 2):
 
 def param_count(params) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_example(data: str, arch: str, image_shape,
+                      n_classes: int = 10):
+    """Analytic FORWARD FLOPs for one example through the registry's
+    model (ISSUE 10: bench.py's MFU trajectory must be computable on any
+    backend — XLA cost analysis needs a compile, this is arithmetic).
+
+    Multiply-accumulates count as 2 FLOPs; elementwise tails (relu,
+    pool, dropout, bias) are <1% on these architectures and are ignored
+    — the same convention as the public MFU formulas. One fwd+bwd
+    training step costs ~3x the forward (the standard 2x-backward
+    estimate). Returns None for architectures without an analytic model
+    here (resnet9) — callers fall back to XLA's cost analysis."""
+    h, w, c = image_shape
+    if arch == "resnet9":
+        return None
+
+    def conv(h, w, cin, cout, k=3):
+        # VALID 3x3 conv: output (h-2)x(w-2), 2*k*k*cin*cout MACs/pixel
+        ho, wo = h - (k - 1), w - (k - 1)
+        return 2 * k * k * cin * cout * ho * wo, ho, wo
+
+    flops = 0
+    if data in ("fmnist", "fedemnist", "synthetic"):
+        # CNN_MNIST: conv(32) -> conv(64) -> pool2 -> fc128 -> fc10
+        f, h, w = conv(h, w, c, 32)
+        flops += f
+        f, h, w = conv(h, w, 32, 64)
+        flops += f
+        h, w = h // 2, w // 2
+        flat = h * w * 64
+        flops += 2 * flat * 128 + 2 * 128 * n_classes
+        return float(flops)
+    if data == "cifar10":
+        # CNN_CIFAR: [conv(width) -> pool2] x (64, 128, 256) -> fc128
+        # -> fc256 -> fc10
+        cin = c
+        for width in (64, 128, 256):
+            f, h, w = conv(h, w, cin, width)
+            flops += f
+            h, w, cin = h // 2, w // 2, width
+        flat = h * w * 256
+        flops += 2 * flat * 128 + 2 * 128 * 256 + 2 * 256 * n_classes
+        return float(flops)
+    return None

@@ -37,9 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-    bind_data, make_chained, vmap_agents)
-from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
-    make_local_train)
+    bind_data, make_block_trainer, make_chained)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     RFA_EPS, RFA_ITERS, agent_sq_dists, apply_aggregate, gaussian_noise_like,
@@ -483,7 +481,11 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     if churn_on:
         from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
             churn as churn_mod)
-    local_train = make_local_train(model, cfg, normalize)
+    # layout-dispatched client-block trainer (ISSUE 10): under
+    # --train_layout megabatch each device folds ITS m/d-client block
+    # into one [mb*bs, ...] megabatch — the fold happens inside the
+    # shard, so the collective plan is untouched by construction
+    train_block = make_block_trainer(model, cfg, normalize)
     m = cfg.agents_per_round
     d = mesh.devices.size
     assert m % d == 0, f"agents_per_round={m} not divisible by mesh size {d}"
@@ -497,7 +499,9 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
         # and off-snap rounds would silently compare different programs
         raise ValueError(
             "--agg_layout bucket does not support --diagnostics (the "
-            "lr tree is never materialized); use --agg_layout leaf")
+            "lr tree is never materialized on the scattered path); "
+            "re-run with --agg_layout leaf — the per-leaf psum plan "
+            "keeps the full lr tree and supports every diagnostic")
 
     def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
         # trailing replicated [m] inputs, in order: corrupt flags (faults /
@@ -526,8 +530,8 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                 ep_local = local(draw.ep_budget)
         # chunking applies to the per-device agent block (m/d agents)
         with jax.named_scope("local_train"):
-            updates, losses = vmap_agents(local_train, params, imgs, lbls,
-                                          szs, keys, cfg.agent_chunk,
+            updates, losses = train_block(params, imgs, lbls, szs, keys,
+                                          cfg.agent_chunk,
                                           ep_budget=ep_local)
         if faults_on:
             from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
@@ -704,10 +708,13 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
     the m sampled shards happens in-jit; the gathered [m, ...] arrays are
     partitioned over the mesh by shard_map's in_specs.
     """
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
     return bind_data(jax.jit(_make_sample_step(cfg, model, normalize, mesh)),
                      (images, labels, sizes),
                      family=("round_sharded_diag" if cfg.diagnostics
-                             else "round_sharded"))
+                             else "round_sharded"
+                             + compile_cache.family_suffix(cfg)))
 
 
 def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):
@@ -836,6 +843,10 @@ def make_sharded_chained_round_fn(cfg, model, normalize, mesh,
     — one XLA program per block, collectives included; key derivation
     (`fold_in(base_key, r)`) matches the driver loop bit-for-bit (see
     fl/rounds.make_chained_round_fn). Diagnostics extras unsupported."""
-    return make_chained(_make_sample_step(cfg.replace(diagnostics=False),
-                                          model, normalize, mesh),
-                        (images, labels, sizes), family="chained_sharded")
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    plain = cfg.replace(diagnostics=False)
+    return make_chained(_make_sample_step(plain, model, normalize, mesh),
+                        (images, labels, sizes),
+                        family="chained_sharded"
+                        + compile_cache.family_suffix(plain))

@@ -160,9 +160,30 @@ class RoundEngine:
         # one-shot trainer) keeps newest-valid semantics. The producer
         # (prepare_crash_exact_resume) has already digest-validated that
         # round, so restore skips re-hashing it.
+        resolved_layout = compile_cache.resolved_train_layout(cfg)
+        if cfg.train_layout != resolved_layout:
+            # same shape as the bucket+diagnostics refusal, but megabatch
+            # has an exact fallback, so degrade loudly instead of dying:
+            # the per-client loss curves (and the diag-variant program
+            # pairing) want the per-client axis, and mixing layouts
+            # between snap and off-snap rounds would silently compare
+            # different programs. The resolver is the single source of
+            # the degrade rule; the engine only normalizes cfg to it.
+            print(f"[layout] --train_layout {cfg.train_layout} does not "
+                  f"support --diagnostics (per-client loss curves need "
+                  f"the per-client axis); degrading this run to "
+                  f"--train_layout {resolved_layout} — drop "
+                  f"--diagnostics to keep the {cfg.train_layout} layout")
+            cfg = cfg.replace(train_layout=resolved_layout)
         self.cfg = cfg
         self._resume_upto = resume_upto
         print_exp_details(cfg)
+        if compile_cache.resolved_train_layout(cfg) == "megabatch":
+            print("[layout] megabatch local training: the client axis "
+                  "folds into the batch — one [m*bs, ...] gather + "
+                  "normalize pass per minibatch step with "
+                  "client-segmented loss/mask reductions (fl/client.py; "
+                  "--train_layout vmap restores the per-client layout)")
         obs_telemetry.check_level(cfg.telemetry)
         impl = apply_rng_impl(cfg.rng_impl)
         if impl != "threefry2x32":
@@ -743,16 +764,17 @@ class RoundEngine:
                     jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
                     for a in (fed.train.images, fed.train.labels,
                               fed.train.sizes))
+                sfx = compile_cache.family_suffix(cfg)
                 if cohort_mode:
-                    fams = ("round_cohort", "round_cohort_diag",
-                            "chained_cohort")
+                    fams = ("round_cohort" + sfx, "round_cohort_diag",
+                            "chained_cohort" + sfx)
                     round_avals = (
                         (p_aval, k_aval,
                          jax.ShapeDtypeStruct((), jnp.int32))
                         + shard_avals)
                 else:
-                    fams = ("round_host", "round_host_diag",
-                            "chained_host")
+                    fams = ("round_host" + sfx, "round_host_diag",
+                            "chained_host" + sfx)
                     flag_avals = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
                                   if host_takes_flags(cfg) else ())
                     round_avals = ((p_aval, k_aval) + shard_avals

@@ -38,6 +38,15 @@ Program families (the manifest vocabulary; see `plan_programs`):
                             program field), and the analysis passes plan
                             them per topology through
                             `plan_sharded_programs`
+    *_mb                    `--train_layout megabatch` (ISSUE 10) twins
+                            of every round/chained family above
+                            (`family_suffix`): the local-training
+                            compute layout folds the client axis into
+                            the batch (fl/client.py), a DIFFERENT traced
+                            program with its own name so the AOT
+                            manifest, the analysis passes and the driver
+                            log all say which layout ran. Eval families
+                            never suffix (no client axis).
     eval_val / eval_poison  the two eval-set program instances
 
 Every entry is a pair of files in `<root>/aot/`: `<family>-<fp>.jex`
@@ -177,6 +186,31 @@ def _arg_shapes(example_args) -> List[Tuple[str, str]]:
             for l in jax.tree_util.tree_leaves(abstractify(example_args))]
 
 
+def resolved_train_layout(cfg) -> str:
+    """Single source of the local-training compute layout (ISSUE 10):
+    `--train_layout megabatch` degrades to vmap under `--diagnostics`
+    (per-client loss curves want the per-client axis; mixing layouts
+    between snap and off-snap rounds would silently compare different
+    programs — the engine prints the loud hint). The AOT fingerprint
+    keys THIS resolved value, so a degraded megabatch config shares the
+    vmap run's cache entries instead of splitting them."""
+    layout = getattr(cfg, "train_layout", "vmap")
+    if layout not in ("vmap", "megabatch"):
+        raise ValueError(
+            f"train_layout must be 'vmap' or 'megabatch', got {layout!r}")
+    if layout == "megabatch" and cfg.diagnostics:
+        return "vmap"
+    return layout
+
+
+def family_suffix(cfg) -> str:
+    """Program-family name suffix for the resolved training layout:
+    megabatch families are DISTINCT programs with distinct names
+    (`round_mb`, `chained_mb`, ...) so manifests, contracts and driver
+    logs never conflate the two layouts."""
+    return "_mb" if resolved_train_layout(cfg) == "megabatch" else ""
+
+
 def fingerprint(cfg, family: str, example_args) -> str:
     """Cache key for one program family: config fields that shape the
     program + jax version + backend + topology + PRNG impl + arg avals.
@@ -186,6 +220,9 @@ def fingerprint(cfg, family: str, example_args) -> str:
         fields.pop(name, None)
     if family not in _DIAG_FAMILIES:
         fields["diagnostics"] = False
+    # the RESOLVED layout keys the cache (a diagnostics-degraded
+    # megabatch config runs the vmap programs — same key, no split)
+    fields["train_layout"] = resolved_train_layout(cfg)
     meta = {
         "family": family,
         "cfg": {k: repr(v) for k, v in sorted(fields.items())},
@@ -453,6 +490,11 @@ def plan_programs(cfg, model, norm, fed,
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         init_params)
 
+    # normalize the layout ONCE so the plain/diag variants derived below
+    # agree with the engine's diagnostics degrade (train.py prints the
+    # hint; here the degrade must simply hold)
+    cfg = cfg.replace(train_layout=resolved_train_layout(cfg))
+    sfx = family_suffix(cfg)
     cohort_mode = is_cohort_mode(cfg, fed)
     if host_mode is None:
         host_mode = (not cohort_mode) and is_host_mode(cfg, fed)
@@ -478,7 +520,8 @@ def plan_programs(cfg, model, norm, fed,
             jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
             for a in data_avals)
         specs.append(ProgramSpec(
-            "round_cohort", make_cohort_round_fn(plain, model, norm),
+            "round_cohort" + sfx,
+            make_cohort_round_fn(plain, model, norm),
             (params_aval, key_aval, rnd_aval) + shard_avals))
         if cfg.diagnostics:
             specs.append(ProgramSpec(
@@ -490,7 +533,7 @@ def plan_programs(cfg, model, norm, fed,
                 jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
                 for a in shard_avals)
             specs.append(ProgramSpec(
-                "chained_cohort",
+                "chained_cohort" + sfx,
                 make_chained_cohort_round_fn(plain, model, norm),
                 (params_aval, key_aval, ids_aval) + block_avals))
     elif host_mode:
@@ -500,7 +543,7 @@ def plan_programs(cfg, model, norm, fed,
         flags = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
                  if host_takes_flags(cfg) else ())
         specs.append(ProgramSpec(
-            "round_host", make_round_fn_host(plain, model, norm),
+            "round_host" + sfx, make_round_fn_host(plain, model, norm),
             (params_aval, key_aval) + shard_avals + flags))
         if cfg.diagnostics:
             specs.append(ProgramSpec(
@@ -511,7 +554,7 @@ def plan_programs(cfg, model, norm, fed,
                 jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
                 for a in shard_avals)
             specs.append(ProgramSpec(
-                "chained_host",
+                "chained_host" + sfx,
                 make_chained_round_fn_host(plain, model, norm),
                 (params_aval, key_aval, ids_aval) + block_avals))
     else:
@@ -521,7 +564,8 @@ def plan_programs(cfg, model, norm, fed,
         lead = ((jax.ShapeDtypeStruct((), jnp.int32),)
                 if cfg.churn_enabled else ())
         specs.append(ProgramSpec(
-            "round", make_round_fn(plain, model, norm, *data_avals).jitted,
+            "round" + sfx,
+            make_round_fn(plain, model, norm, *data_avals).jitted,
             (params_aval, key_aval) + lead + data_avals))
         if cfg.diagnostics:
             specs.append(ProgramSpec(
@@ -530,7 +574,7 @@ def plan_programs(cfg, model, norm, fed,
                 (params_aval, key_aval) + lead + data_avals))
         if chain_n > 1:
             specs.append(ProgramSpec(
-                "chained",
+                "chained" + sfx,
                 make_chained_round_fn(plain, model, norm,
                                       *data_avals).jitted,
                 (params_aval, key_aval, ids_aval) + data_avals))
@@ -563,6 +607,10 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         init_params)
 
+    # same layout normalization as plan_programs (the plain/diag variants
+    # below must agree with the engine's diagnostics degrade)
+    cfg = cfg.replace(train_layout=resolved_train_layout(cfg))
+    sfx = family_suffix(cfg)
     image_shape = fed.train.images.shape[2:]
     params_aval = jax.eval_shape(
         lambda k: init_params(model, image_shape, k), jax.random.PRNGKey(0))
@@ -580,7 +628,7 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
             for a in data_avals)
         rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
         specs.append(ProgramSpec(
-            "round_sharded_cohort",
+            "round_sharded_cohort" + sfx,
             make_sharded_cohort_round_fn(plain, model, norm, mesh),
             (params_aval, key_aval, rnd_aval) + shard_avals))
         return specs
@@ -591,14 +639,14 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
         flags = ((jax.ShapeDtypeStruct((m,), jnp.bool_),)
                  if host_takes_flags(cfg) else ())
         specs.append(ProgramSpec(
-            "round_sharded_host",
+            "round_sharded_host" + sfx,
             make_sharded_round_fn_host(plain, model, norm, mesh),
             (params_aval, key_aval) + shard_avals + flags))
         return specs
     lead = ((jax.ShapeDtypeStruct((), jnp.int32),)
             if cfg.churn_enabled else ())
     specs.append(ProgramSpec(
-        "round_sharded",
+        "round_sharded" + sfx,
         make_sharded_round_fn(plain, model, norm, mesh,
                               *data_avals).jitted,
         (params_aval, key_aval) + lead + data_avals))
@@ -611,7 +659,7 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
     if chain_n > 1:
         ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
         specs.append(ProgramSpec(
-            "chained_sharded",
+            "chained_sharded" + sfx,
             make_sharded_chained_round_fn(plain, model, norm, mesh,
                                           *data_avals).jitted,
             (params_aval, key_aval, ids_aval) + data_avals))

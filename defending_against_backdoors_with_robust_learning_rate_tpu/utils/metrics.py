@@ -65,12 +65,20 @@ def run_name(cfg) -> str:
                      f"n{cfg.samples_per_client}")
         cohort = (f"-coh:K{cfg.num_agents}m{cfg.agents_per_round}"
                   f"-{part}-cs{cfg.cohort_seed}")
+    layout = ""
+    if compile_cache.resolved_train_layout(cfg) == "megabatch":
+        # training-layout cell (ISSUE 10): megabatch results are only
+        # ulp-equal to vmap's, so the two layouts must not share a run
+        # dir (their metrics streams would interleave). The RESOLVED
+        # layout names the dir — a diagnostics-degraded megabatch run
+        # lands in (and is comparable to) the vmap dir it actually ran.
+        layout = "-tl:mb"
     return (f"clip_val:{cfg.clip}"
             f"-noise_std:{cfg.noise}-aggr:{cfg.aggr}"
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
             f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}"
-            f"{faults}{churn}{cohort}")
+            f"{faults}{churn}{cohort}{layout}")
 
 
 class NullWriter:

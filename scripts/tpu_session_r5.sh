@@ -139,14 +139,14 @@ if ! probe_tpu 60 >>"$LOG" 2>&1; then
 fi
 say "TPU alive"
 
-say "step 0/6: precompile + bank all flagship program families (watchdog-free window)"
+say "step 0/7: precompile + bank all flagship program families (watchdog-free window)"
 if python scripts/precompile.py >>"$LOG" 2>&1; then
     say "precompile done — later steps load banked executables"
 else
     say "WARN: precompile rc=$? — steps fall back to jit compiles"
 fi
 
-say "step 1/6: flagship TPU bench (re-land the r3 number; VERDICT next #2)"
+say "step 1/7: flagship TPU bench (re-land the r3 number; VERDICT next #2)"
 # --profile_rounds 3: after the timed blocks, capture a 3-round device
 # trace (obs/attribution.py) — BENCH_TPU_r05.json then carries the
 # compute/collective/gap + named-scope split and the HBM watermarks the
@@ -166,7 +166,7 @@ else
     say "WARN: bench rc=$? — see $LOG"
 fi
 
-say "step 2/6: sweep close-out (probe ladders -> decisions -> all row families -> seeds -> trace -> figures)"
+say "step 2/7: sweep close-out (probe ladders -> decisions -> all row families -> seeds -> trace -> figures)"
 if bash scripts/sweep_close_out.sh logs >>"$LOG" 2>&1; then
     say "close-out done"
     SUCCESSES=$((SUCCESSES + 1))
@@ -174,7 +174,7 @@ else
     say "WARN: close-out rc=$?"
 fi
 
-say "step 3/6: ResNet-9 bf16 bench + selective-remat A/B (VERDICT next #4)"
+say "step 3/7: ResNet-9 bf16 bench + selective-remat A/B (VERDICT next #4)"
 if run_bench logs/bench_resnet9_bf16.txt --bench_config resnet9 --dtype bf16; then
     say "resnet9 bf16 baseline: $(tail -1 logs/bench_resnet9_bf16.txt)"
     SUCCESSES=$((SUCCESSES + 1))
@@ -201,7 +201,7 @@ for AB in "conv -1" "none -1" "none 20" "none 0"; do
     fi
 done
 
-say "step 4/6: faults masking-overhead + telemetry-overhead bench (bench --faults --telemetry full)"
+say "step 4/7: faults masking-overhead + telemetry-overhead bench (bench --faults --telemetry full)"
 # ROADMAP faults axis: the masking-overhead fields (`faults` in the JSON)
 # plus the obs/telemetry.py overhead A/B, one bench invocation; the
 # flagship program family is long-banked so this is measurement, not
@@ -214,7 +214,7 @@ else
     say "WARN: faults/telemetry bench rc=$?"
 fi
 
-say "step 5/6: faults sweep (poison-accuracy cliff under churn -> sweep_faults.jsonl)"
+say "step 5/7: faults sweep (poison-accuracy cliff under churn -> sweep_faults.jsonl)"
 # dropout x rlr_threshold_mode with --faults_spare_corrupt on the fmnist
 # flagship config (scripts/sweep_faults.py); one JSONL row per cell,
 # flushed as cells land, so a mid-sweep kill keeps completed rows
@@ -226,7 +226,33 @@ else
     say "WARN: faults sweep rc=$?"
 fi
 
-say "step 6/6: figures refresh"
+say "step 6/7: train-layout A/B (megabatch vs vmap, ISSUE 10 — BENCH_NOTES r11)"
+# the MFU-push judgment: the SAME flagship config through the chained
+# round program under each local-training layout, with a 3-round device
+# trace after the timed blocks so the r11 template gets the
+# compute/collective/gap attribution next to the per-layout rounds/sec
+# + analytic-FLOP mfu. A second A/B at bf16 decides whether
+# bf16-megabatch becomes the new flagship default (r11 acceptance:
+# >=2x the r3 2.23 rounds/sec at unchanged defense metrics).
+if run_bench logs/bench_r5_train_layout.txt --train_layout both \
+        --profile_rounds 3 --profile_trace_dir logs/bench_profile_mb; then
+    tail -1 logs/bench_r5_train_layout.txt > BENCH_TPU_r05_train_layout.json
+    say "train-layout A/B: $(cat BENCH_TPU_r05_train_layout.json)"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: train-layout A/B rc=$?"
+fi
+if run_bench logs/bench_r5_train_layout_bf16.txt --train_layout both \
+        --dtype bf16; then
+    tail -1 logs/bench_r5_train_layout_bf16.txt \
+        > BENCH_TPU_r05_train_layout_bf16.json
+    say "bf16 train-layout A/B: $(cat BENCH_TPU_r05_train_layout_bf16.json)"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: bf16 train-layout A/B rc=$?"
+fi
+
+say "step 7/7: figures refresh"
 # NOT counted in SUCCESSES: plot_curves re-renders from a pre-existing
 # results.json, so it succeeds even when every measurement step failed —
 # it must not keep the lock held over a zero-measurement session
@@ -241,7 +267,9 @@ python scripts/plot_curves.py >>"$LOG" 2>&1 || say "WARN: plot failed"
 # and the commit to them (unrelated pre-staged work in this checkout is
 # neither swept in nor sole trigger)
 PRESENT=""
-for f in BENCH_TPU_r05.json BENCH_TPU_r05_faults.json sweep_faults.jsonl \
+for f in BENCH_TPU_r05.json BENCH_TPU_r05_faults.json \
+         BENCH_TPU_r05_train_layout.json \
+         BENCH_TPU_r05_train_layout_bf16.json sweep_faults.jsonl \
          results.json RESULTS.md performance.png \
          poison_acc.png BENCH_NOTES.md; do
     [ -e "$f" ] && git add -- "$f" 2>>"$LOG" && PRESENT="$PRESENT $f"

@@ -103,6 +103,11 @@ def test_sharded_chained_matches_sharded_per_round():
     assert stacked["train_loss"].shape == (n,)
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 10): the single-device host
+# chain is redundant coverage — test_sharded_host_chained_matches_per_round
+# runs the SAME make_chained_host scan composed with shard_map (the
+# superset program) and test_chained_matches_per_round_dispatch keeps the
+# vmap chain parity, both in tier-1
 def test_host_chained_matches_per_round_host():
     """Host-sampled chained blocks (fl/rounds.make_chained_round_fn_host)
     must match per-round host dispatch on the same shard payloads + keys."""
