@@ -48,6 +48,12 @@ HOT_PATH_MODULES = (
     f"{PKG}/data/prefetch.py",
     f"{PKG}/train.py",
     "scripts/profile_round.py",
+    # in-jit attack strategies (ISSUE 11): the update transform and its
+    # schedule gate run inside every round program
+    f"{PKG}/attack/registry.py",
+    f"{PKG}/attack/schedule.py",
+    f"{PKG}/attack/boost.py",
+    f"{PKG}/attack/signflip.py",
 )
 
 # Function-level exemptions: (repo-relative path, function qualname prefix)
@@ -64,6 +70,11 @@ ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
     (f"{PKG}/obs/telemetry.py", "emit_scalars"): {
         "host-sync": "host emit path shared by the sync/async metrics "
                      "streams; called only with already-fetched values",
+    },
+    (f"{PKG}/obs/telemetry.py", "host_summary"): {
+        "host-sync": "summary/adaptation snapshot builder on the same "
+                     "post-drain host path as emit_scalars; called only "
+                     "with already-fetched values",
     },
     (f"{PKG}/fl/diagnostics.py", "norm_scalars"): {
         "host-sync": "snap-cadence research diagnostics; --diagnostics "
@@ -426,6 +437,69 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         collective_budget={**zero, "psum": 2 * n_leaves + 2},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
 
+    # adaptive-adversary attack registry (ISSUE 11, attack/registry.py):
+    # the in-jit strategies (boost / signflip) are an elementwise per-row
+    # scale on the stacked updates, with corrupt flags derived from real
+    # client ids and the schedule gate a replicated pure function of the
+    # traced round index — the acceptance claim is ZERO collectives
+    # beyond the plain family's plan on EVERY dispatch surface. The
+    # scheduled variants additionally exercise the takes_round signature
+    # (the round index as a traced lead argument) through the planners.
+    atk_b = {"attack": "boost", "attack_boost": 8.0}
+    atk_s = {"attack": "signflip"}
+    atk_sched = {"attack": "signflip", "attack_start": 2,
+                 "attack_every": 2}
+    specs["vmap_rlr_avg_atk_boost"] = CheckSpec(
+        name="vmap_rlr_avg_atk_boost", family="round", sharded=False,
+        cfg_overrides=dict(atk_b), collective_budget=dict(zero))
+    specs["vmap_rlr_avg_atk_sched"] = CheckSpec(
+        name="vmap_rlr_avg_atk_sched", family="round", sharded=False,
+        cfg_overrides=dict(atk_sched), collective_budget=dict(zero))
+    specs["sharded_rlr_avg_atk_boost"] = CheckSpec(
+        name="sharded_rlr_avg_atk_boost", family="round_sharded",
+        sharded=True, cfg_overrides=dict(atk_b),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_sign_atk_signflip"] = CheckSpec(
+        name="sharded_rlr_sign_atk_signflip", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**atk_s, "aggr": "sign", "server_lr": 1.0},
+        collective_budget={**zero, "psum": n_leaves + 1},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+    specs["sharded_rlr_avg_atk_boost_faults"] = CheckSpec(
+        name="sharded_rlr_avg_atk_boost_faults", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**atk_b, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_chained_rlr_avg_atk_sched"] = CheckSpec(
+        name="sharded_chained_rlr_avg_atk_sched",
+        family="chained_sharded", sharded=True,
+        cfg_overrides={**atk_sched, "chain": 2, "snap": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_atk_signflip"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_atk_signflip",
+        family="round_sharded", sharded=True,
+        cfg_overrides={**atk_s, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_avg_mb_atk_boost"] = CheckSpec(
+        name="sharded_rlr_avg_mb_atk_boost", family="round_sharded_mb",
+        sharded=True,
+        cfg_overrides={**atk_b, "train_layout": "megabatch"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_atk_sched"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_atk_sched",
+        family="round_sharded_cohort", sharded=True,
+        cfg_overrides={**atk_sched, "cohort_sampled": "on"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
     # cohort-sampled population axis (ISSUE 7, data/cohort.py): the
     # in-program cohort draw + active mask are replicated computations
     # feeding the participation-mask protocol — the acceptance claim is
@@ -485,6 +559,15 @@ PROGRAM_READ_MODULES = (
     # cohort_seed / num_agents / agents_per_round (+ churn fields via
     # service/churn.py) — all program provenance
     f"{PKG}/data/cohort.py",
+    # attack schedule (ISSUE 11): the traced gate reads
+    # attack_start/attack_stop/attack_every — program provenance.
+    # (attack/registry.py itself is NOT in scope: its stamp_for_agent is
+    # the host-side data hook and legitimately reads runtime fields like
+    # data_dir; its traced reads — attack, attack_boost — are program-
+    # tagged regardless.)
+    f"{PKG}/attack/schedule.py",
+    f"{PKG}/attack/boost.py",
+    f"{PKG}/attack/signflip.py",
 )
 
 # Provenance classes (config.FIELD_PROVENANCE values) and their

@@ -8,3 +8,6 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.attack.poison imp
     poison_agent_shards,
     build_poisoned_val,
 )
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (  # noqa: F401
+    registry,
+)

@@ -1,0 +1,116 @@
+"""Online RLR-threshold adaptation: the defense side of the adaptive
+scenario matrix.
+
+The RLR threshold θ is a *program constant* — the paper tunes it offline
+per experiment. An adaptive attacker (attack/signflip.py) specifically
+manufactures the regime a fixed θ handles worst: honest vote margins
+collapse until the chosen θ stops separating backdoor coordinates from
+honest ones. The continuous-service driver already computes the
+mechanism's state every round, in-jit, on every path (obs/telemetry.py:
+flip fraction, vote-margin histogram, honest/corrupt cosine split) and
+drains it to the host — this module closes the loop: a deterministic
+host-side controller reads the mid-run ``Defense/*`` telemetry at eval
+boundaries and recommends threshold moves, which ``service.driver.serve``
+applies by rebuilding the round programs from the boundary's checkpoint
+(``--rlr_adapt on``; the AOT bank + persistent XLA cache make a revisited
+threshold a cache hit, not a recompile).
+
+The policy (``recommend_threshold``) is a pure function — unit-tested
+against synthetic telemetry, reproducible in every re-run:
+
+- **raise θ** when the electorate is splitting under the defense's nose:
+  the low-margin mass of the vote-margin histogram is large (the
+  adaptive-attack signature, arXiv:2303.03320) — or the cosine split
+  shows corrupt updates anti-aligned with the aggregate — while the flip
+  fraction says the current θ is barely biting.
+- **lower θ** when the defense is flipping most coordinates
+  (over-defense: honest progress is being reversed wholesale).
+- hysteresis: moves are ±1 per decision, at most one decision per
+  ``--rlr_adapt_every`` eval boundaries, clamped to [1, m-1].
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# policy constants (documented in recommend_threshold's docstring)
+LOW_MARGIN_MASS_HI = 0.25   # histogram mass below m/2 that reads as
+                            # "electorate splitting"
+FLIP_FRAC_LO = 0.05         # defense barely biting
+FLIP_FRAC_HI = 0.50         # defense reversing most coordinates
+COS_SPLIT = 0.10            # |cosine| gap that reads as a corrupt
+                            # anti-alignment signature
+
+
+def low_margin_mass(margin_hist) -> float:
+    """Fraction of coordinates in the lower half of the vote-margin
+    buckets (margins below ~m/2)."""
+    n = len(margin_hist)
+    return float(sum(margin_hist[: max(1, n // 2)]))
+
+
+def recommend_threshold(thr: int, m: int, flip_frac: float,
+                        margin_hist, cos_honest: Optional[float] = None,
+                        cos_corrupt: Optional[float] = None) -> int:
+    """The pure adaptation policy: next θ given one boundary's telemetry.
+
+    Returns a value in [1, m-1]; equal to ``thr`` when no move is
+    warranted. See the module docstring for the rationale of each rule.
+    """
+    if flip_frac >= FLIP_FRAC_HI:
+        return max(1, thr - 1)
+    splitting = low_margin_mass(margin_hist) >= LOW_MARGIN_MASS_HI
+    anti_aligned = (cos_honest is not None and cos_corrupt is not None
+                    and cos_honest > COS_SPLIT
+                    and cos_corrupt < -COS_SPLIT)
+    if (splitting or anti_aligned) and flip_frac <= FLIP_FRAC_LO:
+        return min(max(1, m - 1), thr + 1)
+    return thr
+
+
+class ThresholdController:
+    """Stateful wrapper the service driver owns: validates the config,
+    rate-limits decisions, and tracks the current θ across engine
+    rebuilds (serve passes the controller through its adaptation
+    restarts, so the cadence survives them)."""
+
+    def __init__(self, cfg):
+        if cfg.robustLR_threshold <= 0:
+            raise ValueError("--rlr_adapt on needs the RLR defense "
+                             "enabled (--robustLR_threshold > 0)")
+        if cfg.telemetry != "full":
+            raise ValueError(
+                "--rlr_adapt on adapts from the vote-margin histogram "
+                "and cosine split — run with --telemetry full")
+        if not cfg.checkpoint_dir:
+            raise ValueError(
+                "--rlr_adapt on rebuilds the round programs from the "
+                "boundary checkpoint — set --checkpoint_dir")
+        self.thr = int(cfg.robustLR_threshold)
+        self.m = int(cfg.agents_per_round)
+        self.every = max(1, cfg.rlr_adapt_every)
+        self.moves = []           # [(round, from, to)] decision log
+        self._boundaries = 0
+
+    def consider(self, defense: Optional[Dict], rnd: int) -> Optional[int]:
+        """One eval boundary's decision: the new θ when a move is
+        warranted (and due under the cadence), else None. ``defense`` is
+        the host-fetched telemetry snapshot
+        (obs/telemetry.host_summary — train.py stashes it per
+        boundary)."""
+        if not defense or "tel_flip_frac" not in defense:
+            return None
+        hist = defense.get("tel_margin_hist")
+        if hist is None:
+            return None
+        self._boundaries += 1
+        if self._boundaries % self.every:
+            return None
+        new = recommend_threshold(
+            self.thr, self.m, defense["tel_flip_frac"], hist,
+            defense.get("tel_cos_honest"), defense.get("tel_cos_corrupt"))
+        if new == self.thr:
+            return None
+        self.moves.append((rnd, self.thr, new))
+        self.thr = new
+        return new

@@ -1,0 +1,55 @@
+"""Distributed trigger splitting (DBA): each corrupt client stamps a
+shard of the trojan pattern.
+
+"DBA: Distributed Backdoor Attacks against Federated Learning"
+(ICLR 2020): instead of every attacker stamping the full trigger, the
+pattern's pixels are partitioned across the corrupt cohort — each local
+trigger is smaller (harder to spot, smaller update perturbation per
+client), while the poisoned *validation* trigger stays the full pattern
+(attack/poison.build_poisoned_val, agent_idx=-1), which only fires when
+the global model has composed all the shards.
+
+The reference repo hard-codes a 4-way split of the cifar10 'plus'
+geometry (attack/patterns.py, utils.py:202-224) — that remains the
+``static`` strategy's behavior for exact parity. THIS module is the
+generic registry strategy (``--attack dba``): the FULL pattern's stamped
+coordinates are dealt round-robin (row-major order) across all
+``num_corrupt`` agents, for every dataset and pattern type.
+
+Host-side data poisoning only — the split changes which pixels each
+corrupt client's shard stamps at construction/gather time
+(attack/poison.poison_client_row routes its stamp through
+registry.stamp_for_agent), never the traced program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (
+    Stamp, build_stamp)
+
+
+def split_stamp(stamp: Stamp, shard_idx: int, n_shards: int) -> Stamp:
+    """Shard ``shard_idx`` of an ``n_shards``-way round-robin deal of the
+    stamp's masked coordinates (row-major order): coordinate j of the
+    flattened True-mask positions belongs to shard j % n_shards. The
+    shards partition the full pattern exactly — stamping all of them
+    reproduces the full stamp bitwise."""
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    ys, xs = np.nonzero(stamp.mask)
+    keep = np.arange(len(ys)) % n_shards == shard_idx % n_shards
+    mask = np.zeros_like(stamp.mask)
+    mask[ys[keep], xs[keep]] = True
+    return dataclasses.replace(stamp, mask=mask)
+
+
+def stamp_for_agent(cfg, agent_id: int) -> Stamp:
+    """Corrupt agent ``agent_id``'s trigger shard: the FULL pattern
+    (agent_idx=-1 geometry) split num_corrupt ways."""
+    full = build_stamp(cfg.data, cfg.pattern_type, agent_idx=-1,
+                      data_dir=cfg.data_dir)
+    return split_stamp(full, agent_id, max(1, cfg.num_corrupt))

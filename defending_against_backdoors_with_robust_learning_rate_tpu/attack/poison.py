@@ -51,12 +51,19 @@ def poison_client_row(images_row: np.ndarray, labels_row: np.ndarray,
     when or how often the row is gathered.
 
     images_row: [max_n, H, W, C] raw pixels; labels_row: [max_n];
-    `size` the true shard length. Returns the [max_n] poison mask."""
+    `size` the true shard length. Returns the [max_n] poison mask.
+
+    The stamp geometry comes from the attack registry
+    (attack/registry.stamp_for_agent): `--attack static` resolves to the
+    legacy per-agent stamp bitwise (this function's historical behavior),
+    `--attack dba` to the agent's round-robin shard of the full pattern
+    (attack/dba.py). Index choice and label flip are strategy-blind."""
     max_n = labels_row.shape[0]
     mask = np.zeros((max_n,), dtype=bool)
     if stamp is None:
-        stamp = build_stamp(cfg.data, cfg.pattern_type, agent_idx=agent_id,
-                            data_dir=cfg.data_dir)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+            registry as attack_registry)
+        stamp = attack_registry.stamp_for_agent(cfg, agent_id)
     rng = np.random.default_rng(cfg.seed + seed_offset + agent_id)
     valid = np.arange(max_n) < size
     idxs = select_poison_idxs(labels_row, cfg.base_class, cfg.poison_frac,

@@ -1,0 +1,69 @@
+"""Attack schedules: is the adversary active this round?
+
+A pure function of the traced round index — the exact idiom
+service/churn.py established for client lifecycles: no sequential state,
+so per-round dispatch, chained `lax.scan` blocks and a crash-resumed
+service all reconstruct the identical attack history from the config
+alone, and every device of a mesh computes the same replicated answer
+with zero collectives.
+
+Three shapes compose from the same three fields (rounds are 1-based,
+matching the driver's dispatch schedule):
+
+- **late start** (``--attack_start r``): dormant until round r — the
+  model-replacement regime of arXiv:1807.00459 (attack near convergence,
+  when honest gradients are small and a boosted update survives
+  averaging);
+- **one-shot** (``--attack_start r --attack_stop r+1``): exactly one
+  poisoned round;
+- **intermittent** (``--attack_every n``): every n-th round from
+  ``attack_start``, the low-duty-cycle attacker that dodges
+  rate-triggered defenses.
+
+The schedule gates the *in-jit update strategies* (attack/boost.py,
+attack/signflip.py). The data-poisoning strategies (static, dba) stamp
+client shards at construction time — there is no per-round data to gate —
+so a non-trivial schedule on them is refused loudly
+(attack/registry.check).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def is_trivial(cfg) -> bool:
+    """True when the schedule is the always-on default — the round index
+    is then not needed in-program (fl/rounds.step_takes_round)."""
+    return (cfg.attack_start, cfg.attack_stop, cfg.attack_every) == (0, 0, 1)
+
+
+def check(cfg) -> None:
+    """Validate the schedule fields (registry.check calls this)."""
+    if cfg.attack_start < 0:
+        raise ValueError(f"--attack_start must be >= 0, got "
+                         f"{cfg.attack_start}")
+    if cfg.attack_every < 1:
+        raise ValueError(f"--attack_every must be >= 1, got "
+                         f"{cfg.attack_every}")
+    if cfg.attack_stop < 0 or (cfg.attack_stop > 0
+                               and cfg.attack_stop <= cfg.attack_start):
+        raise ValueError(
+            f"--attack_stop must be 0 (never) or > --attack_start for a "
+            f"non-empty active window, got stop={cfg.attack_stop} "
+            f"start={cfg.attack_start}")
+
+
+def active(cfg, rnd):
+    """Scalar bool: is the attack active at round ``rnd``?
+
+    ``rnd`` may be a traced int32 (the round program's lead argument) or
+    a Python int (host-side mirror — same jnp ops, bit-identical
+    answer)."""
+    rnd = jnp.asarray(rnd, jnp.int32)
+    on = rnd >= cfg.attack_start
+    if cfg.attack_stop > 0:
+        on = on & (rnd < cfg.attack_stop)
+    if cfg.attack_every > 1:
+        on = on & ((rnd - cfg.attack_start) % cfg.attack_every == 0)
+    return on

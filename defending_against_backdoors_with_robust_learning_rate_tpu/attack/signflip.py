@@ -1,0 +1,46 @@
+"""RLR-aware sign-flip voting: corrupt updates vote against the honest
+sign to flip the per-parameter learning rate.
+
+The RLR defense (PAPER.md) thresholds the per-coordinate sign-vote margin
+|sum_k sign(u_k)|: coordinates without enough agreement get learning rate
+-server_lr. An adaptive attacker who knows this ("Learning to Backdoor
+Federated Learning", arXiv:2303.03320, treats the attacker as a learner
+against the deployed defense) does not need a bigger payload — it needs
+to *shrink honest margins*. Each corrupt client trains honestly, then
+submits the NEGATED update: every coordinate where honest clients agree
+loses 2 votes of margin per attacker, dragging coordinates below the
+threshold so the defense itself flips honest progress backwards.
+
+With c corrupt of m voters, a coordinate with unanimous honest agreement
+drops from margin m to m - 2c — the attack wins exactly when the
+threshold θ satisfies m - 2c < θ, which is why the scenario matrix
+(scripts/sweep_scenarios.py) crosses this strategy against threshold
+settings, and why the online threshold adaptation hook (attack/adapt.py)
+watches the vote-margin histogram collapse this attack causes.
+
+What the corrupt clients train ON is the orthogonal data axis: the
+strategy negates whatever the local update is. With ``--poison_frac 0``
+this is the pure untargeted anti-vote described above (honest training,
+negated submission); with the paper's poison settings (the scenario
+matrix's default base) the negated update is of trojan-trained local
+steps — the negation then fights the trigger its own data planted, so
+pair signflip with ``--poison_frac 0`` when you want the clean
+margin-collapse attack in isolation.
+
+``--attack_boost`` composes: scale -boost makes the flipped vote ALSO
+dominate plain averaging (sign flip defeats the vote, boost defeats the
+mean). The transform is attack/boost.py's per-row scale at ``-boost`` —
+one shared gating implementation, collective-free on every path.
+"""
+
+from __future__ import annotations
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+    boost as boost_mod)
+
+
+def scale_rows(corrupt_flags, active, boost: float):
+    """[m] f32 row scale: ``-boost`` on corrupt slots while the schedule
+    is active (the anti-vote), 1 elsewhere — boost's scale at the
+    negated factor, so the two strategies' gating can never drift."""
+    return boost_mod.scale_rows(corrupt_flags, active, -boost)

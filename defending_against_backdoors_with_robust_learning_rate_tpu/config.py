@@ -142,6 +142,35 @@ class Config:
                                     # scaled: threshold * n_eff / m keeps
                                     # the required agreement fraction
                                     # invariant under churn
+    # --- adaptive-adversary attack registry (attack/registry.py) ---
+    attack: str = "static"          # static | dba | boost | signflip —
+                                    # the corrupt cohort's strategy:
+                                    # static = the paper's trojan (data
+                                    # poisoning only, bitwise the
+                                    # pre-registry path); dba = the full
+                                    # pattern dealt across corrupt agents
+                                    # (attack/dba.py); boost / signflip =
+                                    # in-jit update transforms applied
+                                    # inside the round program
+    attack_boost: float = 1.0       # model-replacement scale on corrupt
+                                    # updates (boost: x+boost, signflip:
+                                    # x-boost); 1.0 = magnitude-preserving
+    attack_start: int = 0           # attack schedule (attack/schedule.py,
+                                    # pure function of the traced round
+                                    # index; rounds are 1-based): dormant
+                                    # before this round
+    attack_stop: int = 0            # 0 = never stop; start=k, stop=k+1
+                                    # is the one-shot attack
+    attack_every: int = 1           # intermittent: fire every n-th round
+                                    # from attack_start
+    # --- online RLR-threshold adaptation (attack/adapt.py) ---
+    rlr_adapt: str = "off"          # off | on — the service driver
+                                    # adapts --robustLR_threshold from
+                                    # mid-run Defense/* telemetry at eval
+                                    # boundaries (needs --telemetry full
+                                    # + --checkpoint_dir; service mode)
+    rlr_adapt_every: int = 2        # decide at most every N eval
+                                    # boundaries (hysteresis)
     # --- client churn: arrive/depart/rejoin lifecycles (service/churn.py) ---
     churn_available: float = 1.0    # fraction of lifecycle phases a client
                                     # is present; 1.0 = always there (the
@@ -391,6 +420,21 @@ FIELD_PROVENANCE = {
     "payload_norm_cap": "program",
     "faults_spare_corrupt": "program",
     "rlr_threshold_mode": "program",
+    "attack": "program",           # selects the in-jit update transform
+                                   # (boost/signflip are traced; the
+                                   # data-side strategies shape bank/shard
+                                   # CONTENT — fingerprinting those too is
+                                   # harmless, and one field can carry
+                                   # only one class)
+    "attack_boost": "program",     # baked into the traced row scale
+    "attack_start": "program",     # baked into the traced schedule gate
+    "attack_stop": "program",
+    "attack_every": "program",
+    "rlr_adapt": "runtime",        # service-driver adaptation policy —
+                                   # applied by REBUILDING programs with a
+                                   # new robustLR_threshold, never read in
+                                   # a trace
+    "rlr_adapt_every": "runtime",
     "churn_available": "program",  # churn path is traced (service/churn.py
                                    # draws ride the round program)
     "churn_period": "program",
@@ -591,6 +635,37 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.rlr_threshold_mode,
                    help="RLR vote threshold under faults: abs = paper's "
                         "absolute count; scaled = threshold * n_eff / m")
+    p.add_argument("--attack", choices=("static", "dba", "boost",
+                                        "signflip"),
+                   default=d.attack,
+                   help="adaptive-adversary strategy (attack/registry.py):"
+                        " static = the paper's trojan (bitwise the legacy "
+                        "poison path); dba = distributed trigger split "
+                        "across corrupt agents; boost = model-replacement "
+                        "scaling of corrupt updates; signflip = RLR-aware "
+                        "anti-vote (corrupt updates negated)")
+    p.add_argument("--attack_boost", type=float, default=d.attack_boost,
+                   help="corrupt-update scale for the in-jit strategies "
+                        "(boost applies +x, signflip applies -x)")
+    p.add_argument("--attack_start", type=int, default=d.attack_start,
+                   help="attack schedule: dormant before this round "
+                        "(late-start; rounds are 1-based; in-jit "
+                        "strategies only)")
+    p.add_argument("--attack_stop", type=int, default=d.attack_stop,
+                   help="attack schedule: inactive from this round on "
+                        "(0 = never; start=k stop=k+1 is one-shot)")
+    p.add_argument("--attack_every", type=int, default=d.attack_every,
+                   help="attack schedule: fire every n-th round from "
+                        "--attack_start (intermittent)")
+    p.add_argument("--rlr_adapt", choices=("off", "on"),
+                   default=d.rlr_adapt,
+                   help="service mode: adapt --robustLR_threshold online "
+                        "from mid-run Defense/* telemetry at eval "
+                        "boundaries (attack/adapt.py; needs --telemetry "
+                        "full and --checkpoint_dir)")
+    p.add_argument("--rlr_adapt_every", type=int, default=d.rlr_adapt_every,
+                   help="threshold-adaptation cadence: decide at most "
+                        "every N eval boundaries")
     p.add_argument("--churn_available", type=float, default=d.churn_available,
                    help="client-churn availability: fraction of lifecycle "
                         "phases a client is present (service/churn.py); "

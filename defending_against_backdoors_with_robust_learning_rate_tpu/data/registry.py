@@ -105,11 +105,13 @@ class CohortData(FederatedData):
                     continue
                 stamp = self._stamps.get(cid)
                 if stamp is None:
-                    from defending_against_backdoors_with_robust_learning_rate_tpu.attack.patterns import (
-                        build_stamp)
-                    stamp = build_stamp(cfg.data, cfg.pattern_type,
-                                        agent_idx=cid,
-                                        data_dir=cfg.data_dir)
+                    # attack-registry stamp source (attack/registry.py):
+                    # static = the legacy per-agent stamp, dba = the
+                    # agent's shard of the full pattern — same source as
+                    # the dense build, so rows stay bitwise-identical
+                    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+                        registry as attack_registry)
+                    stamp = attack_registry.stamp_for_agent(cfg, cid)
                     self._stamps[cid] = stamp
                 poison.poison_client_row(imgs[j], lbls[j], int(sizes[j]),
                                          cid, cfg, stamp=stamp)

@@ -47,14 +47,19 @@ def _pallas_applicable(cfg) -> bool:
     which the fused kernel does not take; defense telemetry
     (obs/telemetry.py) likewise needs the explicit lr/aggregate trees, so
     any --telemetry level falls back to the jnp path."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
     # cohort-sampled rounds always carry the active mask (duplicate /
     # churn-absent padding slots must be excluded from aggregation), which
-    # the fused kernel does not take — same fallback as faults/churn
+    # the fused kernel does not take — same fallback as faults/churn.
+    # In-jit attack strategies transform the updates BEFORE the server
+    # step, which the fused kernel's one-pass read would skip.
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
             and cfg.noise == 0 and not cfg.diagnostics
             and not cfg.faults_enabled and not cfg.churn_enabled
+            and not attack_registry.in_jit(cfg)
             and not compile_cache.is_cohort_mode(cfg)
             and cfg.telemetry == "off")
 
@@ -62,11 +67,28 @@ def _pallas_applicable(cfg) -> bool:
 def host_takes_flags(cfg) -> bool:
     """Whether the host-sampled per-round step takes the trailing [m] bool
     corrupt-slot flags argument: the faults path needs them for
-    --faults_spare_corrupt participation, and full telemetry for the
-    honest-vs-corrupt cosine split. Single source for the driver, the AOT
-    aval planner (utils/compile_cache.plan_programs) and the step
-    builders — their signatures must agree."""
-    return cfg.faults_enabled or cfg.telemetry == "full"
+    --faults_spare_corrupt participation, full telemetry for the
+    honest-vs-corrupt cosine split, and the in-jit attack strategies
+    (attack/registry.py) to know which rows to transform. Single source
+    for the driver, the AOT aval planner (utils/compile_cache.
+    plan_programs) and the step builders — their signatures must agree."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
+    return (cfg.faults_enabled or cfg.telemetry == "full"
+            or attack_registry.in_jit(cfg))
+
+
+def step_takes_round(cfg) -> bool:
+    """Whether the round step takes the round index as a traced int32
+    lead argument: the churn lifecycle is a function of time
+    (service/churn.py), and so is a scheduled in-jit attack
+    (attack/schedule.py). Single source for the step builders here and
+    in parallel/rounds.py, the driver's dispatch (train.py) and the AOT
+    aval planner — their signatures must agree. (Cohort steps always
+    take the round index regardless — their sampling consumes it.)"""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
+    return cfg.churn_enabled or attack_registry.needs_round(cfg)
 
 
 def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
@@ -170,7 +192,8 @@ def make_block_trainer(model, cfg, normalize):
 
 
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
-                train_block, cfg, corrupt_flags=None, churn_active=None):
+                train_block, cfg, corrupt_flags=None, churn_active=None,
+                rnd=None):
     """Shared round body: vmapped local training + aggregation + update.
 
     With faults configured (cfg.faults_enabled) the round additionally
@@ -185,7 +208,14 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     mask — an away client's update never reaches aggregation, exactly
     like a dropped one, with zero extra collectives. A churn-only round
     (no fault rates) routes through the masking path too; an all-away
-    cohort degrades to a parameter-preserving no-op via guard_empty."""
+    cohort degrades to a parameter-preserving no-op via guard_empty.
+
+    An in-jit attack strategy (attack/registry.py) transforms the
+    corrupt rows right after local training — BEFORE fault injection and
+    server-side payload validation, so --payload_norm_cap and the robust
+    aggregators see the attacker's payload the way a real server would.
+    `rnd` (traced int32, or None when the step has no round channel)
+    feeds the attack schedule gate."""
     m = imgs.shape[0]
     agent_keys = jax.random.split(k_train, m)
     draw = None
@@ -201,6 +231,12 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         updates, losses = train_block(params, imgs, lbls, sizes,
                                       agent_keys, cfg.agent_chunk,
                                       ep_budget=ep_budget)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
+    if attack_registry.in_jit(cfg):
+        updates = attack_registry.apply_update_attack(
+            cfg, updates, corrupt_flags,
+            attack_registry.schedule_active(cfg, rnd))
     mask = None
     extras = {}
     if draw is not None:
@@ -349,14 +385,15 @@ def _make_sample_step(cfg, model, normalize):
             train_block=train_block, cfg=cfg,
             corrupt_flags=(sampled < cfg.num_corrupt
                            if want_flags else None),
-            churn_active=churn_active)
+            churn_active=churn_active, rnd=rnd)
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
-    if cfg.churn_enabled:
-        # churn needs the round index in-program (the lifecycle phase is a
-        # function of time, not of the round key): the step grows a traced
-        # int32 `rnd` argument, threaded by the driver / the chained scan
+    if step_takes_round(cfg):
+        # churn — and a scheduled in-jit attack — need the round index
+        # in-program (the lifecycle phase / attack window is a function
+        # of time, not of the round key): the step grows a traced int32
+        # `rnd` argument, threaded by the driver / the chained scan
         def step(params, key, rnd, images, labels, sizes):
             return body(params, key, rnd, images, labels, sizes)
         step.takes_round = True
@@ -438,6 +475,25 @@ def make_host_step(cfg, model, normalize, take_flags=None):
         raise ValueError(
             "client churn (--churn_available < 1) is not supported in "
             "host-sampled mode; run device-resident (--host_sampled off)")
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
+    if attack_registry.needs_round(cfg):
+        # same contract as churn: the per-round host step has no round
+        # channel for the schedule gate to read. Fail loudly rather than
+        # silently running the attack always-on (or never).
+        raise ValueError(
+            f"--attack {cfg.attack} with a schedule "
+            f"(attack_start/attack_stop/attack_every) is not supported "
+            f"in host-sampled mode; run device-resident "
+            f"(--host_sampled off) or cohort-sampled")
+    if take_flags is False and attack_registry.in_jit(cfg):
+        # the chained host scan has no per-round flag channel; a silently
+        # unapplied attack would corrupt every scenario row downstream
+        raise ValueError(
+            f"--attack {cfg.attack} transforms updates in-jit and needs "
+            f"the corrupt-slot flags, which the chained host scan does "
+            f"not carry — the driver must dispatch host-sampled attack "
+            f"rounds unchained (train.py disables --chain here)")
     train_block = make_block_trainer(model, cfg, normalize)
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
@@ -559,7 +615,7 @@ def make_cohort_step(cfg, model, normalize):
             train_block=train_block, cfg=cfg,
             corrupt_flags=((ids < cfg.num_corrupt) & active
                            if want_flags else None),
-            churn_active=active)
+            churn_active=active, rnd=rnd)
         return new_params, {"train_loss": train_loss, "sampled": ids,
                             **extras}
 

@@ -340,6 +340,26 @@ def compute_sharded_bucket(cfg, updates_local, info, axis_name,
 
 # --- host side -----------------------------------------------------------
 
+def host_summary(vals) -> dict:
+    """JSON-able snapshot of the telemetry values in `vals`
+    (host-fetched): tel_* scalars as floats, tel_margin_hist as a float
+    list. One source for everything downstream of the drain that wants
+    the mechanism's state as data rather than metrics rows — the run
+    summary's ``defense`` block (train.py, and through it every
+    scenario-matrix JSONL cell, scripts/sweep_scenarios.py) and the
+    online threshold-adaptation controller (attack/adapt.py)."""
+    out = {}
+    for key in sorted(vals):
+        if not key.startswith(PREFIX):
+            continue
+        v = vals[key]
+        if getattr(v, "ndim", 0) or isinstance(v, (list, tuple)):
+            out[key] = [float(x) for x in v]
+        else:
+            out[key] = float(v)
+    return out
+
+
 def emit_scalars(writer, vals, step: int) -> None:
     """Write every telemetry value in `vals` (host-fetched) as Defense/*
     scalars. Shared by the sync and async metrics paths, so the jsonl

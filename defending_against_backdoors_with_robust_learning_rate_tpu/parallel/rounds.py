@@ -468,11 +468,23 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     input (default: on iff churn is configured). The cohort-sampled
     builders force it on — their active mask (shortfall padding) rides
     the same input whether or not churn is configured — still with ZERO
-    added collectives (the mask arrives replicated)."""
+    added collectives (the mask arrives replicated).
+
+    An in-jit attack strategy (attack/registry.py) scales this device's
+    corrupt rows right after local training — the flags arrive replicated
+    and the transform is elementwise, so the collective plan is untouched
+    on the leaf AND bucketed layouts (pinned by the *_atk_* contract
+    specs). A *scheduled* attack adds one more trailing replicated input:
+    the scalar schedule gate, computed OUTSIDE shard_map from the round
+    index (like the churn mask — the body never needs the index itself)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         _pallas_applicable, host_takes_flags)
     faults_on = cfg.faults_enabled
     churn_on = cfg.churn_enabled if take_active is None else take_active
+    atk_on = attack_registry.in_jit(cfg)
+    atk_sched = attack_registry.needs_round(cfg)
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     if faults_on:
@@ -504,20 +516,25 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             "keeps the full lr tree and supports every diagnostic")
 
     def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
-        # trailing replicated [m] inputs, in order: corrupt flags (faults /
-        # full telemetry), then the churn availability mask — the caller
-        # computes the lifecycle draw OUTSIDE shard_map (it needs the
-        # sampled ids + round index) and it arrives replicated, so churn
-        # adds ZERO collectives (analysis *_churn specs pin this)
+        # trailing replicated inputs, in order: [m] corrupt flags (faults /
+        # full telemetry / in-jit attack), the [m] churn availability
+        # mask, then the scalar attack-schedule gate — the caller
+        # computes the lifecycle draw and the schedule gate OUTSIDE
+        # shard_map (they need the sampled ids / round index) and they
+        # arrive replicated, so neither adds a collective (analysis
+        # *_churn / *_atk_* specs pin this)
         idx = 0
-        corrupt_full = churn_full = None
+        corrupt_full = churn_full = atk_active = None
         if take_flags:
             corrupt_full = rest[idx]
             idx += 1
         if churn_on:
             churn_full = rest[idx]
+            idx += 1
+        if atk_sched:
+            atk_active = rest[idx]
         mask_local = mask_full = draw = ep_local = None
-        if faults_on or churn_on:
+        if faults_on or churn_on or atk_on:
             pos = jax.lax.axis_index(AGENTS_AXIS) * mb
 
             def local(v):
@@ -533,6 +550,11 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             updates, losses = train_block(params, imgs, lbls, szs, keys,
                                           cfg.agent_chunk,
                                           ep_budget=ep_local)
+        if atk_on:
+            # each device scales ITS corrupt rows — elementwise on the
+            # local block, replicated inputs, zero collectives
+            updates = attack_registry.apply_update_attack(
+                cfg, updates, local(corrupt_full), atk_active)
         if faults_on:
             from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
                 masking)
@@ -643,7 +665,7 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
 
     in_specs = (P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
                 P(AGENTS_AXIS), P()) + ((P(),) if take_flags else ()) \
-        + ((P(),) if churn_on else ())
+        + ((P(),) if churn_on else ()) + ((P(),) if atk_sched else ())
     return shard_map(
         shard_body, mesh=mesh,
         in_specs=in_specs,
@@ -661,8 +683,10 @@ def _make_sample_step(cfg, model, normalize, mesh):
     stays bit-identical to per-round dispatch. The dataset stacks are jit
     ARGUMENTS, not closure captures (closure arrays get inlined into the
     lowered HLO as dense constants — see fl/rounds._make_sample_step)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-        host_takes_flags)
+        host_takes_flags, step_takes_round)
     sharded = _build_sharded_body(cfg, model, normalize, mesh)
     K, m = cfg.num_agents, cfg.agents_per_round
     want_flags = host_takes_flags(cfg)
@@ -683,12 +707,16 @@ def _make_sample_step(cfg, model, normalize, mesh):
             # sampled ids + round index); enters the body replicated
             with jax.named_scope("churn_mask"):
                 extra = extra + (churn_mod.active_slots(cfg, sampled, rnd),)
+        if attack_registry.needs_round(cfg):
+            # schedule gate computed OUTSIDE shard_map from the round
+            # index; enters the body as a replicated scalar
+            extra = extra + (attack_registry.schedule_active(cfg, rnd),)
         new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
                                                  agent_keys, k_noise, *extra)
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
-    if cfg.churn_enabled:
+    if step_takes_round(cfg):
         def step(params, key, rnd, images, labels, sizes):
             return body(params, key, rnd, images, labels, sizes)
         step.takes_round = True
@@ -723,6 +751,8 @@ def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):
     (split into k_train/k_noise, then m agent keys) matches
     fl/rounds.make_host_step bit-for-bit, so the sharded and single-device
     host paths are comparable round-for-round."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         host_takes_flags)
     if cfg.churn_enabled:
@@ -731,6 +761,19 @@ def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):
         raise ValueError(
             "client churn (--churn_available < 1) is not supported in "
             "host-sampled mode; run device-resident (--host_sampled off)")
+    if attack_registry.needs_round(cfg):
+        # same contract as the single-device host step: no round channel
+        # for the schedule gate (fl/rounds.make_host_step)
+        raise ValueError(
+            f"--attack {cfg.attack} with a schedule is not supported in "
+            f"host-sampled mode; run device-resident (--host_sampled "
+            f"off) or cohort-sampled")
+    if take_flags is False and attack_registry.in_jit(cfg):
+        raise ValueError(
+            f"--attack {cfg.attack} transforms updates in-jit and needs "
+            f"the corrupt-slot flags, which the chained host scan does "
+            f"not carry — the driver must dispatch host-sampled attack "
+            f"rounds unchained (train.py disables --chain here)")
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     sharded = _build_sharded_body(cfg, model, normalize, mesh,
@@ -792,6 +835,8 @@ def make_sharded_cohort_step(cfg, model, normalize, mesh):
     body as replicated [m] inputs, so the whole population/cohort split
     adds ZERO collectives to the documented communication plan (pinned by
     the *_cohort specs in analysis/contracts.py)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
         cohort as cohort_mod)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
@@ -808,6 +853,8 @@ def make_sharded_cohort_step(cfg, model, normalize, mesh):
         agent_keys = jax.random.split(k_train, m)
         extra = (((ids < cfg.num_corrupt) & active,) if want_flags else ())
         extra = extra + (active,)
+        if attack_registry.needs_round(cfg):
+            extra = extra + (attack_registry.schedule_active(cfg, rnd),)
         new_params, train_loss, extras = sharded(params, imgs, lbls, szs,
                                                  agent_keys, k_noise, *extra)
         return new_params, {"train_loss": train_loss, "sampled": ids,

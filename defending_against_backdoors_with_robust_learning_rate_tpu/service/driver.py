@@ -132,10 +132,21 @@ def prepare_crash_exact_resume(cfg: Config, truncate: bool = True) -> Dict:
 
 
 def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
-          max_rounds: Optional[int] = None) -> Dict:
+          max_rounds: Optional[int] = None, _adapt=None,
+          _adapt_reentry: bool = False) -> Dict:
     """Run the continuous service; returns the engine summary extended
     with a ``service`` section (retry/degradation counters, recovery
-    info)."""
+    info).
+
+    With ``--rlr_adapt on`` the service additionally hosts the online
+    defense-adaptation loop (attack/adapt.py): at eval boundaries the
+    controller reads the drained Defense/* telemetry; when it recommends
+    a threshold move, the current engine is torn down at the boundary
+    checkpoint and serve re-enters with
+    ``robustLR_threshold=<new>`` — same writer (one continuous metrics
+    stream), same checkpoint dir, the controller carried through
+    (``_adapt``) so its cadence and decision log survive the restart.
+    Revisited thresholds are AOT/XLA cache hits, not recompiles."""
     t_start = time.perf_counter()
     total = max_rounds if max_rounds is not None else cfg.service_rounds
     # supervision granularity is one round per dispatch unit; `rounds`
@@ -147,7 +158,18 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                       service_keep_ckpts=(3 if cfg.service_keep_ckpts < 0
                                           else cfg.service_keep_ckpts))
     lead = jax.process_index() == 0
-    recovery = prepare_crash_exact_resume(cfg, truncate=lead)
+    if _adapt_reentry:
+        # adaptation re-entry is NOT a crash: the stream and its writer
+        # are alive and must continue untouched. The crash-exact prepare
+        # would compute a phantom metrics path (run_name embeds the
+        # adapted threshold) and report recovery against a file nobody
+        # writes — resume directly from the boundary checkpoint instead.
+        rnd0 = ckpt.newest_resumable_round(cfg.checkpoint_dir) or 0
+        recovery = {"resumed_from": rnd0, "metrics_offset": None,
+                    "truncated_bytes": 0, "resume_upto": rnd0,
+                    "boundary": False}
+    else:
+        recovery = prepare_crash_exact_resume(cfg, truncate=lead)
     if writer is None:
         if lead:
             writer = MetricsWriter(cfg.log_dir, run_name(cfg),
@@ -161,6 +183,15 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                                if cfg.chaos else None))
     if chaos.active:
         print(f"[service] chaos injections armed: {cfg.chaos}")
+
+    adapt = _adapt
+    if cfg.rlr_adapt == "on" and adapt is None:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+            adapt as adapt_mod)
+        adapt = adapt_mod.ThresholdController(cfg)   # validates loudly
+        print(f"[adapt] online RLR-threshold adaptation armed: start "
+              f"thr={adapt.thr}, decide every {adapt.every} eval "
+              f"boundary(ies) from Defense/* telemetry")
 
     eng = RoundEngine(cfg, writer=writer,
                       resume_upto=recovery["resume_upto"])
@@ -197,6 +228,7 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
     # pinned as the host-mode prefetcher's production order
     eng.set_schedule(unit_stream())
     evals_skipped = 0
+    adapt_to = None   # (new_threshold, boundary_round) when a move fires
     try:
         for unit in unit_stream():
             rnd = unit[0]
@@ -278,6 +310,19 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                         "Service/Active_Clients",
                         churn_mod.active_count(cfg, rnd), rnd)
                 _emit_service_rows(eng, sup, evals_skipped, rnd)
+                if (adapt is not None
+                        and eng.mstate.get("defense_round") == rnd):
+                    # the boundary's checkpoint step flushed the drain,
+                    # so the telemetry stash is host-complete here; the
+                    # freshness stamp gates out boundaries whose eval
+                    # was skipped/degraded — the controller must never
+                    # decide (or advance its cadence) on the PREVIOUS
+                    # boundary's snapshot
+                    new_thr = adapt.consider(eng.mstate.get("defense"),
+                                             rnd)
+                    if new_thr is not None:
+                        adapt_to = (new_thr, rnd)
+                        break
             eng.post_unit()
         if eng.drain is not None:
             eng.hb.update(phase="drain", force=True)
@@ -290,6 +335,41 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         raise
     finally:
         eng.close()
+    if adapt_to is not None:
+        new_thr, at_rnd = adapt_to
+        old_thr = cfg.robustLR_threshold
+        eng.hb.update(phase="adapt", force=True, adapt_round=at_rnd,
+                      adapt_threshold=new_thr)
+        print(f"[adapt] RLR threshold {old_thr} -> {new_thr} at round "
+              f"{at_rnd} (Defense/* telemetry; rebuilding round programs "
+              f"from the boundary checkpoint)")
+        # re-enter with the adapted program constant: same writer (one
+        # continuous metrics stream), same checkpoint dir (the boundary's
+        # checkpoint is the resume point), controller carried through so
+        # the decision cadence/log survive
+        outer_wall = time.perf_counter() - t_start
+        sub = serve(cfg.replace(robustLR_threshold=new_thr),
+                    writer=writer, max_rounds=total, _adapt=adapt,
+                    _adapt_reentry=True)
+        # the reliability record must cover the WHOLE run, not just the
+        # last segment: fold this segment's supervisor counters into the
+        # inner serve's service section
+        svc = sub.setdefault("service", {})
+        for key, extra in ({**sup.counters,
+                            "evals_skipped": evals_skipped,
+                            "rounds_served": eng.rounds_done,
+                            "wall_s": outer_wall}).items():
+            svc[key] = round(svc.get(key, 0) + extra, 3)
+        svc["phases_seen"] = sorted(set(svc.get("phases_seen", []))
+                                    | set(sup.phases_seen))
+        if not _adapt_reentry:
+            # the outermost segment's recovery info is the run's real
+            # origin (inner re-entries report the adaptation boundary)
+            svc["resumed_from"] = recovery["resumed_from"]
+            svc["truncated_bytes"] = recovery["truncated_bytes"]
+        svc["adaptations"] = [
+            {"round": r, "from": f, "to": t} for r, f, t in adapt.moves]
+        return sub
     eng.hb.update(force=True, evals_skipped=evals_skipped,
                   **sup.heartbeat_fields())
     summary = eng.finalize()

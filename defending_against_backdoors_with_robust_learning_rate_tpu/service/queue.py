@@ -42,7 +42,12 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
     Config, args_parser)
 
 SUMMARY_KEYS = ("round", "val_acc", "val_loss", "poison_acc", "poison_loss",
-                "rounds_per_sec", "steady_rounds_per_sec", "params")
+                "rounds_per_sec", "steady_rounds_per_sec", "params",
+                # the last boundary's Defense/* telemetry snapshot
+                # (obs/telemetry.host_summary via train.py): the
+                # scenario matrix (scripts/sweep_scenarios.py) records
+                # defense state per cell, not just outcomes
+                "defense")
 
 
 def load_cells(path: str) -> List[Dict[str, Any]]:

@@ -36,9 +36,11 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import 
     make_normalizer)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
     make_eval_fn, pad_eval_set)
+from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+    registry as attack_registry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     CHAINED_INFO_KEYS, FAULT_INFO_KEYS, host_takes_flags, make_round_fn,
-    make_round_fn_host)
+    make_round_fn_host, step_takes_round)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
     telemetry as obs_telemetry)
@@ -185,6 +187,13 @@ class RoundEngine:
                   "client-segmented loss/mask reductions (fl/client.py; "
                   "--train_layout vmap restores the per-client layout)")
         obs_telemetry.check_level(cfg.telemetry)
+        # attack-config validation, loudly and before any build
+        # (attack/registry.py: unknown strategy, bad boost, schedule on a
+        # data-side strategy)
+        attack_registry.check(cfg)
+        atk_banner = attack_registry.banner(cfg)
+        if atk_banner:
+            print(atk_banner)
         impl = apply_rng_impl(cfg.rng_impl)
         if impl != "threefry2x32":
             print(f"[rng] {impl} bit generator")
@@ -555,10 +564,14 @@ class RoundEngine:
             # the host step then takes per-round corrupt flags the chained
             # scan doesn't carry (device-resident chaining computes them
             # in-jit and is unaffected).
-            if chain_n > 1 and cfg.faults_enabled:
+            if chain_n > 1 and (cfg.faults_enabled
+                                or attack_registry.in_jit(cfg)):
                 chain_n = 1
-                print("[faults] host-sampled mode: --chain disabled "
-                      "(per-round corrupt flags ride each dispatch)")
+                tag, why = (("faults", "faults") if cfg.faults_enabled
+                            else ("attack", f"--attack {cfg.attack}"))
+                print(f"[{tag}] host-sampled mode: --chain disabled "
+                      f"({why} needs per-round corrupt flags riding "
+                      f"each dispatch)")
             if chain_n > 1:
                 if n_mesh > 1:
                     from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
@@ -747,10 +760,11 @@ class RoundEngine:
             ab = compile_cache.abstractify
             p_aval, k_aval = ab(params), ab(base_key)
             ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
-            # churn round programs take the round index as a traced int32
-            # scalar (service/churn.py; single source with plan_programs)
+            # churn — and scheduled-attack — round programs take the
+            # round index as a traced int32 scalar (single source
+            # fl/rounds.step_takes_round, with plan_programs)
             lead_avals = ((jax.ShapeDtypeStruct((), jnp.int32),)
-                          if cfg.churn_enabled else ())
+                          if step_takes_round(cfg) else ())
             if cohort_mode or host_sampler is not None:
                 # one adoption triad (round / diag / chained block) for
                 # both [m, ...]-stack branches; they differ only in
@@ -918,8 +932,12 @@ class RoundEngine:
 
     # ------------------------------------------------------------- stepping
 
-    def _churn_lead(self, rnd):
-        return ((jnp.int32(rnd),) if self.cfg.churn_enabled else ())
+    def _round_lead(self, rnd):
+        # churn — and scheduled-attack — round programs take the round
+        # index as a traced lead argument (fl/rounds.step_takes_round is
+        # the single source; the AOT aval planner agrees)
+        return ((jnp.int32(rnd),)
+                if step_takes_round(self.cfg) else ())
 
     def dispatch(self, unit) -> None:
         """Run one dispatch unit (a single round or a chained block):
@@ -973,7 +991,7 @@ class RoundEngine:
                     fn = (self._diag_round_fn if self._want_diag
                           else self._round_fn)
                     self.params, info = fn(self.params, key,
-                                           *self._churn_lead(rnd))
+                                           *self._round_lead(rnd))
             self.rnd = rnd
             self.rounds_done += 1
         self._last_info = info
@@ -1156,6 +1174,18 @@ class RoundEngine:
             "round": ernd, "val_loss": val_loss, "val_acc": val_acc,
             "poison_loss": poison_loss, "poison_acc": poison_acc,
             "rounds_per_sec": rounds_done_now / elapsed}
+        tel = obs_telemetry.host_summary(vals)
+        if tel:
+            # the mechanism's state as data: the scenario-matrix rows
+            # (service/queue.py SUMMARY_KEYS) and the online threshold-
+            # adaptation controller (attack/adapt.py — reads the stash
+            # after the boundary's drain flush) both consume this
+            mstate["summary"]["defense"] = tel
+            mstate["defense"] = tel
+            # freshness stamp: a skipped/degraded eval boundary must not
+            # let the adaptation controller decide on the previous
+            # boundary's snapshot (service/driver.py checks this)
+            mstate["defense_round"] = ernd
         if mstate["t_steady"] is None:
             # first eval boundary done: every program variant on the hot
             # path has now compiled (or loaded) at least once

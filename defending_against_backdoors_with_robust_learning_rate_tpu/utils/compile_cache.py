@@ -114,6 +114,13 @@ EXCLUDED_FIELDS = frozenset({
     # (cohort_seed/cohort_size and the partitioner fields by contrast DO
     # shape programs or data and are fingerprinted)
     "cohort_sampled", "bank_dir", "bank_shard_clients",
+    # online RLR-threshold adaptation (attack/adapt.py): a host-side
+    # service policy — it ACTS by rebuilding programs with a different
+    # robustLR_threshold (which is fingerprinted), never by changing a
+    # trace itself. The attack/attack_* strategy fields by contrast ARE
+    # traced (attack/registry.py update hook + schedule) and stay in the
+    # fingerprint.
+    "rlr_adapt", "rlr_adapt_every",
     # NOT here: `agg_layout` (ISSUE 8). It selects the sharded
     # aggregation program (per-leaf psums vs bucketed reduce-scatter,
     # parallel/rounds.py reads it at trace time), so it must stay in the
@@ -399,12 +406,16 @@ def setup(cfg):
 def chain_budget(cfg, host_mode: bool = False, cohort: bool = False) -> int:
     """Rounds fused per dispatch — the driver's exact budget: capped at
     `snap` (minus the unchained diagnostic snap round), and 1 in
-    host-sampled mode under faults (per-round corrupt flags ride each
-    dispatch; train.py prints the reason). Cohort-sampled mode keeps its
-    chain under faults: the scanned round index re-derives the flags
-    in-program (fl/rounds.make_cohort_step)."""
+    host-sampled mode under faults OR an in-jit attack strategy
+    (per-round corrupt flags ride each dispatch; train.py prints the
+    reason). Cohort-sampled mode keeps its chain under both: the scanned
+    round index re-derives the flags in-program
+    (fl/rounds.make_cohort_step)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry)
     n = max(1, min(cfg.chain, cfg.snap - (1 if cfg.diagnostics else 0)))
-    if host_mode and cfg.faults_enabled and not cohort:
+    if (host_mode and not cohort
+            and (cfg.faults_enabled or attack_registry.in_jit(cfg))):
         return 1
     return n
 
@@ -486,7 +497,8 @@ def plan_programs(cfg, model, norm, fed,
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         host_takes_flags, make_chained_cohort_round_fn,
         make_chained_round_fn, make_chained_round_fn_host,
-        make_cohort_round_fn, make_round_fn, make_round_fn_host)
+        make_cohort_round_fn, make_round_fn, make_round_fn_host,
+        step_takes_round)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         init_params)
 
@@ -558,11 +570,12 @@ def plan_programs(cfg, model, norm, fed,
                 make_chained_round_fn_host(plain, model, norm),
                 (params_aval, key_aval, ids_aval) + block_avals))
     else:
-        # churn round programs take the round index as a traced int32
-        # scalar (service/churn.py: the lifecycle phase is a function of
-        # time, not of the round key)
+        # churn — and scheduled-attack — round programs take the round
+        # index as a traced int32 scalar (service/churn.py,
+        # attack/schedule.py: functions of time, not of the round key;
+        # single source fl/rounds.step_takes_round)
         lead = ((jax.ShapeDtypeStruct((), jnp.int32),)
-                if cfg.churn_enabled else ())
+                if step_takes_round(cfg) else ())
         specs.append(ProgramSpec(
             "round" + sfx,
             make_round_fn(plain, model, norm, *data_avals).jitted,
@@ -603,7 +616,7 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
         make_sharded_chained_round_fn, make_sharded_cohort_round_fn,
         make_sharded_round_fn, make_sharded_round_fn_host)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
-        host_takes_flags)
+        host_takes_flags, step_takes_round)
     from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
         init_params)
 
@@ -644,7 +657,7 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
             (params_aval, key_aval) + shard_avals + flags))
         return specs
     lead = ((jax.ShapeDtypeStruct((), jnp.int32),)
-            if cfg.churn_enabled else ())
+            if step_takes_round(cfg) else ())
     specs.append(ProgramSpec(
         "round_sharded" + sfx,
         make_sharded_round_fn(plain, model, norm, mesh,

@@ -65,6 +65,23 @@ def run_name(cfg) -> str:
                      f"n{cfg.samples_per_client}")
         cohort = (f"-coh:K{cfg.num_agents}m{cfg.agents_per_round}"
                   f"-{part}-cs{cfg.cohort_seed}")
+    atk = ""
+    if cfg.attack != "static":
+        # attack-registry cell (ISSUE 11): scenario-matrix cells
+        # differing only in strategy / boost / schedule must not collide
+        # into one run dir (the rlr_threshold_mode bug class PR 3 fixed).
+        # `static` stays cell-free so every pre-registry baseline keeps
+        # its historical run dir.
+        from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+            schedule as attack_schedule)
+        # poison_frac rides the cell too: it is the attack's data
+        # intensity, and scenario cells differing only in it (e.g. the
+        # signflip vs signflip_clean vocabulary pair) must not share a
+        # run dir. Base (static) names never carried it and stay as-is.
+        atk = f"-atk:{cfg.attack}b{cfg.attack_boost}p{cfg.poison_frac}"
+        if not attack_schedule.is_trivial(cfg):
+            atk += (f"s{cfg.attack_start}e{cfg.attack_every}"
+                    + (f"t{cfg.attack_stop}" if cfg.attack_stop else ""))
     layout = ""
     if compile_cache.resolved_train_layout(cfg) == "megabatch":
         # training-layout cell (ISSUE 10): megabatch results are only
@@ -78,7 +95,7 @@ def run_name(cfg) -> str:
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
             f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}"
-            f"{faults}{churn}{cohort}{layout}")
+            f"{faults}{churn}{cohort}{atk}{layout}")
 
 
 class NullWriter:

@@ -23,6 +23,13 @@ threshold mode and spare flag, so cells never collide).
     python scripts/sweep_faults.py                     # full ladder
     python scripts/sweep_faults.py --dropout_rates 0,0.3 --rounds 50
 
+This driver is the faults-only slice; the general scenario matrix
+(attacks x aggregation rules x faults, ISSUE 11) is its generalization:
+scripts/sweep_scenarios.py runs over the experiment queue with the same
+one-flushed-row-per-cell discipline plus record-and-skip on failed
+cells. This ladder stays as-is because the TPU session scripts
+reference its exact output schema.
+
 The masking *overhead* companion number comes from `bench.py --faults`
 (recorded in the session's BENCH_*.json), not from this driver — sweep
 rows measure defense outcomes, the bench measures cost.

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Scenario-matrix driver: attacks x aggregation rules x faults, one
+JSONL row per cell.
+
+The generalization of scripts/sweep_faults.py the ROADMAP's
+adaptive-adversary item calls for: every cell is one experiment crossing
+an attack-registry strategy (attack/registry.py — static trojan, DBA
+trigger split, model-replacement boosting, RLR-aware sign flipping, with
+optional schedules), a named defense/aggregation rule, and a fault
+regime. Cells run back-to-back in ONE process over the experiment queue
+(service/queue.run_queue) against one shared AOT bank + persistent XLA
+cache, so program-identical cells re-dispatch banked executables instead
+of paying XLA per cell. Each finished cell appends one flushed row —
+final/poison accuracy plus the last boundary's Defense/* telemetry
+snapshot (flip fraction, vote-margin histogram, cosine split) — and a
+failed cell is recorded with its error and SKIPPED: one poisoned cell
+never aborts the matrix.
+
+Axes (comma lists; see ATTACKS/RULES/FAULTS for the vocabulary)::
+
+    python scripts/sweep_scenarios.py                       # 12-cell default
+    python scripts/sweep_scenarios.py \
+        --attacks static,boost,signflip,dba,boost_late \
+        --rules avg,rlr,sign_rlr,comed,trmean,krum,rfa \
+        --faults none,drop30 --rounds 50
+
+CI-scale smoke (synthetic data, seconds per cell)::
+
+    python scripts/sweep_scenarios.py --synth_train_size 256 \
+        --rounds 4 --snap 2 --attacks static,signflip --rules avg,rlr \
+        --faults none
+
+Row schema (the queue's row shape, service/queue.py): {"cell":
+"<attack>|<rule>|<fault>", "overrides", "ok", "summary": {val_acc,
+poison_acc, ..., "defense": {tel_*}}, "wall_s"} — the axis names are
+the "|"-separated components of "cell".
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rules_vocab(thr: int):
+    """Named defense/aggregation rules. `rlr` suffixes pair a rule with
+    the RLR per-parameter sign-vote defense at threshold `thr` (the
+    paper's defense); bare names run the rule undefended."""
+    return {
+        "avg": {"aggr": "avg", "robustLR_threshold": 0},
+        "rlr": {"aggr": "avg", "robustLR_threshold": thr},
+        "sign": {"aggr": "sign", "server_lr": 1.0,
+                 "robustLR_threshold": 0},
+        "sign_rlr": {"aggr": "sign", "server_lr": 1.0,
+                     "robustLR_threshold": thr},
+        "comed": {"aggr": "comed", "robustLR_threshold": 0},
+        "trmean": {"aggr": "trmean", "robustLR_threshold": 0},
+        "krum": {"aggr": "krum", "robustLR_threshold": 0},
+        "rfa": {"aggr": "rfa", "robustLR_threshold": 0},
+    }
+
+
+def attacks_vocab(boost: float, rounds: int):
+    """Named attack-registry scenarios (attack/registry.py strategies +
+    attack/schedule.py windows). Scheduled variants derive their rounds
+    from the sweep length."""
+    mid = max(1, rounds // 2)
+    return {
+        "static": {"attack": "static"},
+        "dba": {"attack": "dba"},
+        "boost": {"attack": "boost", "attack_boost": boost},
+        "signflip": {"attack": "signflip"},
+        # the pure untargeted anti-vote: honest (unpoisoned) local
+        # training, negated submission (attack/signflip.py docstring)
+        "signflip_clean": {"attack": "signflip", "poison_frac": 0.0},
+        "signflip_boost": {"attack": "signflip", "attack_boost": boost},
+        # late start: dormant until mid-run (attack near convergence)
+        "boost_late": {"attack": "boost", "attack_boost": boost,
+                       "attack_start": mid},
+        # one-shot model replacement at mid-run
+        "boost_oneshot": {"attack": "boost", "attack_boost": boost,
+                          "attack_start": mid, "attack_stop": mid + 1},
+        # low-duty-cycle anti-vote
+        "signflip_intermittent": {"attack": "signflip",
+                                  "attack_every": 2},
+    }
+
+
+FAULTS = {
+    "none": {},
+    # adversarial participation: honest clients churn, attackers never do
+    "drop30": {"dropout_rate": 0.3, "faults_spare_corrupt": True},
+    "drop50": {"dropout_rate": 0.5, "faults_spare_corrupt": True},
+    # fair dropout control: attackers drop at the same rate
+    "drop30_fair": {"dropout_rate": 0.3},
+}
+
+
+def build_cells(attack_names, rule_names, fault_names, boost, rounds, thr):
+    attacks = attacks_vocab(boost, rounds)
+    rules = rules_vocab(thr)
+    cells = []
+    for a in attack_names:
+        if a not in attacks:
+            raise SystemExit(f"unknown attack {a!r}; choose from "
+                             f"{sorted(attacks)}")
+        for r in rule_names:
+            if r not in rules:
+                raise SystemExit(f"unknown rule {r!r}; choose from "
+                                 f"{sorted(rules)}")
+            for f in fault_names:
+                if f not in FAULTS:
+                    raise SystemExit(f"unknown fault regime {f!r}; "
+                                     f"choose from {sorted(FAULTS)}")
+                cells.append({
+                    "name": f"{a}|{r}|{f}",
+                    "overrides": {**attacks[a], **rules[r], **FAULTS[f]},
+                })
+    return cells
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--attacks", default="static,boost,signflip",
+                    help="comma list of attack scenarios "
+                         "(see attacks_vocab)")
+    ap.add_argument("--rules", default="avg,rlr",
+                    help="comma list of defense/aggregation rules "
+                         "(see rules_vocab)")
+    ap.add_argument("--faults", default="none,drop30",
+                    help="comma list of fault regimes (see FAULTS)")
+    ap.add_argument("--boost", type=float, default=8.0,
+                    help="attack_boost for the boosted scenarios "
+                         "(~cohort size replaces the average)")
+    ap.add_argument("--rlr_threshold", type=int, default=0,
+                    help="RLR threshold for the *rlr rules "
+                         "(0 = the base config's, i.e. the paper value)")
+    ap.add_argument("--rounds", type=int, default=200,
+                    help="FL rounds per cell (flagship default)")
+    ap.add_argument("--snap", type=int, default=10,
+                    help="eval cadence within each cell")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--telemetry", choices=("off", "basic", "full"),
+                    default="full",
+                    help="in-jit defense telemetry per cell (full: the "
+                         "rows carry the margin histogram + cosine "
+                         "split — the matrix's whole point)")
+    ap.add_argument("--out", default="sweep_scenarios.jsonl",
+                    help="output JSONL (one row per cell, appended + "
+                         "flushed)")
+    ap.add_argument("--log_dir", default="./logs",
+                    help="per-cell run dirs land under here (run_name's "
+                         "-atk:/-flt: cells keep them collision-free)")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (cpu|tpu); empty = default")
+    ap.add_argument("--synth_train_size", type=int, default=0,
+                    help="override the synthetic dataset size (forces "
+                         "the synthetic generator; CI-scale smoke); "
+                         "0 = flagship fmnist default")
+    ap.add_argument("--inject_bad_cell", action="store_true",
+                    help="append a deliberately poisoned cell (unknown "
+                         "aggregator) to prove the record-and-skip "
+                         "contract — its failure does not fail the sweep")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from bench import bench_config
+    from defending_against_backdoors_with_robust_learning_rate_tpu.service.queue import (
+        run_queue)
+
+    base = bench_config("fmnist").replace(
+        rounds=args.rounds, snap=args.snap, seed=args.seed,
+        telemetry=args.telemetry, log_dir=args.log_dir, tensorboard=False)
+    if args.synth_train_size:
+        base = base.replace(
+            num_agents=8, bs=16, local_ep=1, num_corrupt=2,
+            poison_frac=1.0, eval_bs=64,
+            synth_train_size=args.synth_train_size,
+            synth_val_size=max(64, args.synth_train_size // 4),
+            data_dir="/nonexistent_use_synthetic_reduced")
+    thr = args.rlr_threshold or base.robustLR_threshold
+
+    split = lambda s: [x.strip() for x in s.split(",") if x.strip()]  # noqa: E731
+    cells = build_cells(split(args.attacks), split(args.rules),
+                        split(args.faults), args.boost, args.rounds, thr)
+    injected = None
+    if args.inject_bad_cell:
+        injected = {"name": "injected|bogus|none",
+                    "overrides": {"aggr": "bogus_rule"}}
+        cells.append(injected)
+    print(f"[scenarios] {len(cells)} cells: {args.attacks} x {args.rules} "
+          f"x {args.faults} (boost {args.boost}, thr {thr}) -> {args.out}")
+
+    rows = run_queue(base, cells, results_path=args.out)
+    ok = [r for r in rows if r["ok"]]
+    for r in rows:
+        if r["ok"]:
+            summ = r.get("summary", {})
+            print(f"[scenarios] {r['cell']:<40} "
+                  f"val={summ.get('val_acc')} "
+                  f"poison={summ.get('poison_acc')}")
+        else:
+            print(f"[scenarios] {r['cell']:<40} FAILED: {r.get('error')}")
+    expected_ok = len(cells) - (1 if injected else 0)
+    print(f"[scenarios] complete: {len(ok)}/{len(cells)} cells ok "
+          f"-> {args.out}")
+    # the injected poisoned cell MUST fail (that is its job); every real
+    # cell must complete
+    if injected is not None:
+        bad = next(r for r in rows if r["cell"] == injected["name"])
+        if bad["ok"]:
+            print("[scenarios] ERROR: the injected bad cell succeeded?!")
+            return 1
+    return 0 if len(ok) == expected_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

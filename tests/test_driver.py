@@ -113,6 +113,12 @@ def test_round_prefetcher_error_while_queue_full():
     pf.close()
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 11): chunk-vs-full parity is
+# redundantly covered by the cheap twins
+# test_megabatch.py::test_trainer_parity_f32_with_pgd_and_chunk (both
+# layouts through the same _run_chunked scaffold at trainer level) and
+# the loud non-divisor refusal unit test; this driver-level run costs
+# ~18s of duplicate compile (its sharded variant was already gated)
 def test_driver_agent_chunk_parity():
     """--agent_chunk trades round latency for peak activation HBM; agents
     train independently, so chunked results must match the full vmap."""
