@@ -241,6 +241,16 @@ def main():
                          "reduce-scatter plan on the local mesh, with "
                          "jaxpr + compiled-HLO collective counts per "
                          "layout in the output JSON (agg_layout_ab)")
+    ap.add_argument("--agg_mode", choices=("sync", "buffered", "both"),
+                    default="sync",
+                    help="aggregation mode (ISSUE 12, fl/buffered.py): "
+                         "buffered runs the headline through the "
+                         "buffered-async tick program; both ALSO "
+                         "measures an A/B — buffered at K=m (the pure "
+                         "mode overhead, acceptance <=3%%) plus sync "
+                         "rounds/sec vs buffered ticks/sec at 30%%/50%% "
+                         "straggler rates (agg_mode_ab in the output "
+                         "JSON; BENCH_NOTES r13)")
     ap.add_argument("--status_file", default="logs/status.json",
                     help="heartbeat path (obs/heartbeat.py) the session "
                          "stall detector reads; empty disables")
@@ -379,6 +389,10 @@ def main():
                           synth_val_size=max(512,
                                              args.synth_train_size // 10),
                           data_dir="/nonexistent_use_synthetic_reduced")
+    if args.agg_mode == "buffered":
+        # headline through the buffered tick program (K=m by default —
+        # the staleness-0 cadence that matches sync round-for-round)
+        cfg = cfg.replace(agg_mode="buffered")
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
 
@@ -412,6 +426,14 @@ def main():
         so a prior measurement's buffer cannot be reused."""
         params = init_params(model, fed.train.images.shape[2:],
                              jax.random.PRNGKey(0))
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+            buffered as buffered_mod)
+        if buffered_mod.is_buffered(mcfg):
+            # buffered mode: the chained scan carries the (params,
+            # buffer-state) pair; the AOT example aval below follows
+            # automatically (params IS the carry)
+            params = (params, buffered_mod.init_state(mcfg, params,
+                                                      per_bin=True))
         # chained execution: blocks of rounds fused into one lax.scan
         # dispatch (bit-identical to per-round dispatch; see fl/rounds.py)
         chained = make_chained_round_fn(mcfg, model, norm, *arrays)
@@ -500,6 +522,10 @@ def main():
                 "source": "bench --profile_rounds"})
             log(f"[bench]{label} profiled {p_blocks * chain} extra rounds "
                 f"-> {profile_dir}")
+        if buffered_mod.is_buffered(mcfg):
+            # downstream consumers (eval, FLOP cost analysis) want the
+            # bare model params, not the (params, buffer-state) carry
+            params = params[0]
         return params, rounds_per_sec, compile_s, cache_info
 
     params, rounds_per_sec, compile_s, cache_info = measure(
@@ -883,6 +909,37 @@ def main():
     except Exception as e:  # informative, never fatal
         log(f"[bench] host-sync probe unavailable: {e}")
 
+    agg_mode_ab = None
+    if args.agg_mode == "both":
+        # buffered-async A/B (ISSUE 12): (1) buffered at K=m, staleness 0
+        # — the pure mode overhead (acceptance: ticks/sec within 3% of
+        # sync rounds/sec; the fold arithmetic is the only delta); (2) at
+        # 30%/50% straggler rates, sync rounds/sec (the barrier pays the
+        # latency on the simulated clock) vs buffered ticks/sec at
+        # K=m/2 — the production-shape comparison the r13 notes judge.
+        hb.update(phase="agg_mode_ab", force=True)
+        _, r_buf, c_buf, _ = measure(cfg.replace(agg_mode="buffered"),
+                                     label="[agg_mode buffered K=m]")
+        agg_mode_ab = {
+            "sync": {"rounds_per_sec": round(rounds_per_sec, 4)},
+            "buffered": {"ticks_per_sec": round(r_buf, 4),
+                         "compile_s": round(c_buf, 1)},
+            "buffered_vs_sync": round(r_buf / rounds_per_sec, 4)}
+        for rate in (0.3, 0.5):
+            scfg = cfg.replace(straggler_rate=rate)
+            _, r_s, _, _ = measure(scfg,
+                                   label=f"[sync straggler={rate}]")
+            _, r_b, _, _ = measure(
+                scfg.replace(agg_mode="buffered",
+                             async_buffer_k=max(
+                                 1, cfg.agents_per_round // 2)),
+                label=f"[buffered K=m/2 straggler={rate}]")
+            agg_mode_ab[f"straggler_{rate}"] = {
+                "sync_rounds_per_sec": round(r_s, 4),
+                "buffered_ticks_per_sec": round(r_b, 4)}
+        log(f"[bench] buffered/sync throughput ratio at K=m: "
+            f"{agg_mode_ab['buffered_vs_sync']:.3f}x")
+
     agg_ab_out = None
     if args.agg_layout:
         # sharded-layout A/B (ISSUE 8): the SAME flagship config through
@@ -1045,6 +1102,9 @@ def main():
         out["attribution"] = attribution_out
     if agg_ab_out is not None:
         out["agg_layout_ab"] = agg_ab_out
+    out["agg_mode"] = cfg.agg_mode
+    if agg_mode_ab is not None:
+        out["agg_mode_ab"] = agg_mode_ab
     if hbm:
         out["hbm"] = hbm
     # per-phase span aggregates (obs/spans.py): where this bench's wall

@@ -95,6 +95,12 @@ ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
                            "never traced (the make_ builder convention "
                            "false-positives here)",
     },
+    (f"{PKG}/fl/buffered.py", "host_latency_draw"): {
+        "host-sync": "host MIRROR of the in-program arrival draw (the "
+                     "churn/cohort mirror idiom): returns numpy for the "
+                     "scenario sweep's simulated clock and the arrival-"
+                     "timing tests; never called on the dispatch path",
+    },
     (f"{PKG}/ops/loops.py", "maybe_unrolled_scan"): {
         "jit-side-effect": "RLR_SCAN_MODE/RLR_SCAN_UNROLL are deliberate "
                            "trace-time measurement overrides (module "
@@ -130,6 +136,12 @@ DONATED_FAMILIES: Tuple[str, ...] = (
     "chained", "chained_mb", "chained_host", "chained_host_mb",
     "chained_cohort", "chained_cohort_mb",
     "chained_sharded", "chained_sharded_mb",
+    # buffered-async twins (ISSUE 12): the chained scan donates the whole
+    # (params, buffer) carry — without it every dispatched block would
+    # hold two copies of the buffer state on top of the params pair
+    "chained_async", "chained_async_mb", "chained_cohort_async",
+    "chained_cohort_async_mb", "chained_sharded_async",
+    "chained_sharded_async_mb",
 )
 
 # --------------------------------------------------------------------------
@@ -497,6 +509,76 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         name="sharded_rlr_avg_cohort_atk_sched",
         family="round_sharded_cohort", sharded=True,
         cfg_overrides={**atk_sched, "cohort_sampled": "on"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # buffered-async aggregation (ISSUE 12, fl/buffered.py): the carried
+    # buffer fold is elementwise on the replicated (leaf) or bucketed
+    # (reduce-scatter) shard, and the per-level contribution sums RIDE
+    # the sync plan's collectives — per-leaf psums carry [S+1]-stacked
+    # partials instead of plain leaves (a shape change, not a count
+    # change), and the tiny count/weight/loss lanes pack into ONE vector
+    # psum that replaces the sync plan's weight-total psum + loss pmean.
+    # The acceptance claim is therefore ZERO collectives beyond each
+    # mode's pinned plan: vmap stays collective-free, avg+RLR stays
+    # within 2L+2 psums (measured 2L+1: the packing saves one), sign+RLR
+    # within L+1, faults still add exactly the one [m]-bit validation
+    # all_gather, and the bucket layout keeps its reduce-scatter 1 /
+    # all_gather 1 / psum<=2 shape. The `_stale` spec runs WITH
+    # stragglers so the level-stacked (pending-ladder) shape is the one
+    # being judged, not just the staleness-0 fast path.
+    buf = {"agg_mode": "buffered"}
+    specs["vmap_rlr_avg_async"] = CheckSpec(
+        name="vmap_rlr_avg_async", family="round_async", sharded=False,
+        cfg_overrides=dict(buf), collective_budget=dict(zero))
+    specs["vmap_rlr_avg_async_mb"] = CheckSpec(
+        name="vmap_rlr_avg_async_mb", family="round_async_mb",
+        sharded=False,
+        cfg_overrides={**buf, "train_layout": "megabatch"},
+        collective_budget=dict(zero))
+    specs["sharded_rlr_avg_async"] = CheckSpec(
+        name="sharded_rlr_avg_async", family="round_sharded_async",
+        sharded=True, cfg_overrides=dict(buf),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_sign_async"] = CheckSpec(
+        name="sharded_rlr_sign_async", family="round_sharded_async",
+        sharded=True,
+        cfg_overrides={**buf, "aggr": "sign", "server_lr": 1.0},
+        collective_budget={**zero, "psum": n_leaves + 1},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+    specs["sharded_rlr_avg_async_stale"] = CheckSpec(
+        name="sharded_rlr_avg_async_stale", family="round_sharded_async",
+        sharded=True,
+        cfg_overrides={**buf, "straggler_rate": 0.5,
+                       "async_buffer_k": 4},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_async_faults"] = CheckSpec(
+        name="sharded_rlr_avg_async_faults", family="round_sharded_async",
+        sharded=True,
+        cfg_overrides={**buf, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_async"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_async", family="round_sharded_async",
+        sharded=True, cfg_overrides={**buf, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_chained_rlr_avg_async"] = CheckSpec(
+        name="sharded_chained_rlr_avg_async",
+        family="chained_sharded_async", sharded=True,
+        cfg_overrides={**buf, "chain": 2, "snap": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_async"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_async",
+        family="round_sharded_cohort_async", sharded=True,
+        cfg_overrides={**buf, "cohort_sampled": "on"},
         collective_budget={**zero, "psum": 2 * n_leaves + 2},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
 

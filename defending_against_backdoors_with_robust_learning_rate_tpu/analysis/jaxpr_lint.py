@@ -287,8 +287,9 @@ def telemetry_off_findings(sharded: bool = False) -> List[Finding]:
         compile_cache)
     path = f"{contracts.PKG}/obs/telemetry.py"
     specs = contracts.check_specs()
-    names = (("sharded_rlr_avg", "sharded_rlr_avg_bucket") if sharded
-             else ("vmap_rlr_avg",))
+    names = (("sharded_rlr_avg", "sharded_rlr_avg_bucket",
+              "sharded_rlr_avg_async") if sharded
+             else ("vmap_rlr_avg", "vmap_rlr_avg_async"))
 
     def tripwire(*_a, **_k):
         raise AssertionError("telemetry computed under --telemetry off")

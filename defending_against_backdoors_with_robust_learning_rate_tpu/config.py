@@ -142,6 +142,34 @@ class Config:
                                     # scaled: threshold * n_eff / m keeps
                                     # the required agreement fraction
                                     # invariant under churn
+    # --- buffered-async aggregation (fl/buffered.py, FedBuff-shape) ---
+    agg_mode: str = "sync"          # sync | buffered — sync barriers every
+                                    # round on the slowest client (the
+                                    # historical path, bit-identical);
+                                    # buffered folds each arriving update
+                                    # into a persistent staleness-weighted
+                                    # buffer carried across ticks and
+                                    # commits an aggregate only when
+                                    # --async_buffer_k updates have
+                                    # arrived. Arrival latency rides the
+                                    # straggler draw: a straggling
+                                    # client's update lands T ticks later
+                                    # with staleness T (no epoch
+                                    # truncation in buffered mode).
+                                    # avg/sign (± RLR) only; refuses
+                                    # pallas/--diagnostics/host-sampled.
+    async_buffer_k: int = 0         # arrivals per commit (FedBuff's K);
+                                    # 0 = auto: the cohort size m (then
+                                    # staleness-0 runs commit every tick,
+                                    # reproducing the sync path)
+    async_staleness_exp: float = 0.0  # staleness-weight exponent a: an
+                                    # arrival with staleness T folds with
+                                    # weight 1/(1+T)^a; 0 = unweighted
+                                    # (every arrival counts fully)
+    async_max_staleness: int = 4    # max latency draw T (ticks) for a
+                                    # straggling client; bounds the
+                                    # carried pending-arrival state and
+                                    # the staleness telemetry bins
     # --- adaptive-adversary attack registry (attack/registry.py) ---
     attack: str = "static"          # static | dba | boost | signflip —
                                     # the corrupt cohort's strategy:
@@ -420,6 +448,15 @@ FIELD_PROVENANCE = {
     "payload_norm_cap": "program",
     "faults_spare_corrupt": "program",
     "rlr_threshold_mode": "program",
+    "agg_mode": "program",         # selects the buffered-async round
+                                   # program (fl/buffered.py carried
+                                   # buffer state + fold/commit are
+                                   # traced) — distinct *_async families
+    "async_buffer_k": "program",   # baked into the traced commit gate
+    "async_staleness_exp": "program",  # baked into the traced staleness
+                                       # weight
+    "async_max_staleness": "program",  # shapes the carried pending state
+                                       # and the latency draw range
     "attack": "program",           # selects the in-jit update transform
                                    # (boost/signflip are traced; the
                                    # data-side strategies shape bank/shard
@@ -635,6 +672,31 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.rlr_threshold_mode,
                    help="RLR vote threshold under faults: abs = paper's "
                         "absolute count; scaled = threshold * n_eff / m")
+    p.add_argument("--agg_mode", choices=("sync", "buffered"),
+                   default=d.agg_mode,
+                   help="aggregation mode (fl/buffered.py): sync = every "
+                        "round barriers on the slowest client (the "
+                        "historical path); buffered = FedBuff-shape — "
+                        "arriving updates fold into a persistent "
+                        "staleness-weighted buffer carried across ticks, "
+                        "the server commits when --async_buffer_k have "
+                        "arrived, and a straggling client's update lands "
+                        "T ticks later with staleness T (avg/sign ± RLR "
+                        "only)")
+    p.add_argument("--async_buffer_k", type=int, default=d.async_buffer_k,
+                   help="buffered mode: arrivals per commit (0 = auto: "
+                        "the cohort size m — staleness-0 then reproduces "
+                        "the sync path)")
+    p.add_argument("--async_staleness_exp", type=float,
+                   default=d.async_staleness_exp,
+                   help="buffered mode: staleness-weight exponent a — an "
+                        "arrival with staleness T folds with weight "
+                        "1/(1+T)^a (0 = unweighted)")
+    p.add_argument("--async_max_staleness", type=int,
+                   default=d.async_max_staleness,
+                   help="buffered mode: max latency draw in ticks for a "
+                        "straggling client (bounds the carried pending "
+                        "state and the staleness telemetry bins)")
     p.add_argument("--attack", choices=("static", "dba", "boost",
                                         "signflip"),
                    default=d.attack,

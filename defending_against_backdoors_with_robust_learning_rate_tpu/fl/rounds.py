@@ -24,6 +24,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+    buffered)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
     make_local_train, make_local_train_megabatch)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import loops
@@ -34,8 +36,10 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate imp
 # blocks carry through their lax.scan alongside train_loss
 FAULT_INFO_KEYS = ("fault_dropped", "fault_straggled", "fault_voters")
 # everything a chained scan carries per-round besides train_loss/tel_*:
-# the fault counters plus the churn away count (service/churn.py)
-CHAINED_INFO_KEYS = FAULT_INFO_KEYS + ("churn_away",)
+# the fault counters, the churn away count (service/churn.py) and the
+# buffered-async fill/commit/staleness scalars (fl/buffered.py)
+CHAINED_INFO_KEYS = (FAULT_INFO_KEYS + ("churn_away",)
+                     + buffered.ASYNC_INFO_KEYS)
 
 
 def _pallas_applicable(cfg) -> bool:
@@ -61,6 +65,7 @@ def _pallas_applicable(cfg) -> bool:
             and not cfg.faults_enabled and not cfg.churn_enabled
             and not attack_registry.in_jit(cfg)
             and not compile_cache.is_cohort_mode(cfg)
+            and not buffered.is_buffered(cfg)
             and cfg.telemetry == "off")
 
 
@@ -193,7 +198,7 @@ def make_block_trainer(model, cfg, normalize):
 
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 train_block, cfg, corrupt_flags=None, churn_active=None,
-                rnd=None):
+                rnd=None, astate=None):
     """Shared round body: vmapped local training + aggregation + update.
 
     With faults configured (cfg.faults_enabled) the round additionally
@@ -215,7 +220,13 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     server-side payload validation, so --payload_norm_cap and the robust
     aggregators see the attacker's payload the way a real server would.
     `rnd` (traced int32, or None when the step has no round channel)
-    feeds the attack schedule gate."""
+    feeds the attack schedule gate.
+
+    `astate` (fl/buffered.py carried buffer state) routes the aggregation
+    tail through the buffered-async fold instead of the immediate
+    aggregate+apply; the straggler draw then delays the upload (latency
+    draw) instead of truncating epochs, and the return grows a fourth
+    element (the advanced buffer state)."""
     m = imgs.shape[0]
     agent_keys = jax.random.split(k_train, m)
     draw = None
@@ -226,7 +237,12 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         draw = fmodel.sample_faults(cfg, fmodel.fault_key(k_noise), m,
                                     corrupt_flags)
         if cfg.straggler_rate > 0:
-            ep_budget = draw.ep_budget
+            # buffered mode repurposes the straggler flags as the arrival
+            # latency draw — a slow client uploads LATE (full epochs)
+            # instead of truncated; the builder's signature still takes
+            # the budget, so hand it the full-epoch constant
+            ep_budget = (draw.ep_budget if astate is None
+                         else jnp.full((m,), cfg.local_ep, jnp.int32))
     with jax.named_scope("local_train"):
         updates, losses = train_block(params, imgs, lbls, sizes,
                                       agent_keys, cfg.agent_chunk,
@@ -266,6 +282,29 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 extras["churn_away"] = churn_mod.churn_away(churn_active)
         elif cfg.churn_enabled:
             extras = churn_mod.churn_only_scalars(churn_active, mask)
+    if astate is not None:
+        # buffered-async tail (fl/buffered.py): this tick's updates fold
+        # into the carried buffer by arrival level; params advance only
+        # when the commit gate fires. lr/agg are the buffer's current
+        # vote — telemetry describes the commit decision either way.
+        with jax.named_scope("buffered_fold"):
+            T = buffered.latency(
+                cfg, k_noise, draw.straggler if draw is not None else None)
+            contribs = buffered.tick_contributions(cfg, updates, sizes,
+                                                   mask, T)
+            new_params, new_astate, lr, agg, a_extras, vote_sign = \
+                buffered.fold_commit(cfg, params, astate, contribs,
+                                     k_noise, m)
+        extras.update(a_extras)
+        if cfg.telemetry != "off":
+            from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                telemetry)
+            extras.update(telemetry.compute(
+                cfg, updates, lr if cfg.robustLR_threshold > 0 else None,
+                agg, mask=mask, corrupt_flags=corrupt_flags,
+                sign_sums=vote_sign,
+                vote_range=buffered.vote_range(cfg)))
+        return new_params, jnp.mean(losses), extras, new_astate
     if _pallas_applicable(cfg):   # never taken when faults are configured
         from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
             fused_rlr_avg_apply)
@@ -362,8 +401,13 @@ def _make_sample_step(cfg, model, normalize):
     compile services and re-shipped on every compile)."""
     train_block = make_block_trainer(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
+    is_async = buffered.is_buffered(cfg)
 
-    def body(params, key, rnd, images, labels, sizes):
+    def body(carry, key, rnd, images, labels, sizes):
+        # buffered mode: the step's first argument is the (params,
+        # buffer-state) carry — one pytree the chained scan, the AOT
+        # avals, checkpointing and donation all treat as "the params"
+        params, astate = carry if is_async else (carry, None)
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         with jax.named_scope("sample_gather"):
             sampled = jax.random.permutation(k_sample, K)[:m]
@@ -380,12 +424,18 @@ def _make_sample_step(cfg, model, normalize):
                 churn as churn_mod)
             with jax.named_scope("churn_mask"):
                 churn_active = churn_mod.active_slots(cfg, sampled, rnd)
-        new_params, train_loss, extras = _round_core(
+        res = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
             train_block=train_block, cfg=cfg,
             corrupt_flags=(sampled < cfg.num_corrupt
                            if want_flags else None),
-            churn_active=churn_active, rnd=rnd)
+            churn_active=churn_active, rnd=rnd, astate=astate)
+        if is_async:
+            new_params, train_loss, extras, new_astate = res
+            return ((new_params, new_astate),
+                    {"train_loss": train_loss, "sampled": sampled,
+                     **extras})
+        new_params, train_loss, extras = res
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
@@ -475,6 +525,14 @@ def make_host_step(cfg, model, normalize, take_flags=None):
         raise ValueError(
             "client churn (--churn_available < 1) is not supported in "
             "host-sampled mode; run device-resident (--host_sampled off)")
+    if buffered.is_buffered(cfg):
+        # same contract as churn: the buffered arrival draw and carried
+        # buffer have no host-sampled channel (fl/buffered.check names
+        # the remediation) — fail loudly rather than silently syncing
+        raise ValueError(
+            "--agg_mode buffered is not supported in host-sampled mode; "
+            "run device-resident (--host_sampled off) or cohort-sampled "
+            "(--cohort_sampled on)")
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
         registry as attack_registry)
     if attack_registry.needs_round(cfg):
@@ -605,17 +663,24 @@ def make_cohort_step(cfg, model, normalize):
         cohort as cohort_mod)
     train_block = make_block_trainer(model, cfg, normalize)
     want_flags = host_takes_flags(cfg)
+    is_async = buffered.is_buffered(cfg)
 
-    def step(params, key, rnd, imgs, lbls, sizes):
+    def step(carry, key, rnd, imgs, lbls, sizes):
+        params, astate = carry if is_async else (carry, None)
         with jax.named_scope("cohort_sample"):
             ids, active = cohort_mod.sample_cohort(cfg, rnd)
         k_train, k_noise = jax.random.split(key)
-        new_params, train_loss, extras = _round_core(
+        res = _round_core(
             params, k_train, k_noise, imgs, lbls, sizes,
             train_block=train_block, cfg=cfg,
             corrupt_flags=((ids < cfg.num_corrupt) & active
                            if want_flags else None),
-            churn_active=active, rnd=rnd)
+            churn_active=active, rnd=rnd, astate=astate)
+        if is_async:
+            new_params, train_loss, extras, new_astate = res
+            return ((new_params, new_astate),
+                    {"train_loss": train_loss, "sampled": ids, **extras})
+        new_params, train_loss, extras = res
         return new_params, {"train_loss": train_loss, "sampled": ids,
                             **extras}
 

@@ -57,6 +57,10 @@ TAGS = {
     "tel_margin_hist": "Defense/Vote_Margin_Hist",
     "tel_cos_honest": "Defense/Cosine_Honest_To_Agg",
     "tel_cos_corrupt": "Defense/Cosine_Corrupt_To_Agg",
+    # per-staleness-bin split (fl/buffered.py, --agg_mode buffered +
+    # --telemetry full on the vmap paths): one row per staleness bin
+    "tel_stale_flip": "Defense/Stale_Flip_Fraction",
+    "tel_stale_cos": "Defense/Stale_Cosine_To_Agg",
 }
 
 
@@ -165,13 +169,21 @@ def _total_coords(updates) -> int:
 
 # --- single-device (vmap) path -------------------------------------------
 
-def compute(cfg, updates, lr, agg, mask=None, corrupt_flags=None):
+def compute(cfg, updates, lr, agg, mask=None, corrupt_flags=None,
+            sign_sums=None, vote_range=None):
     """Telemetry dict for the vmap round path. `updates` leaves are
     [m, ...]; `lr` is the robust-lr tree or None (RLR disabled); `agg` the
     aggregate tree; `mask` the [m] participation mask or None;
-    `corrupt_flags` the [m] corrupt-slot flags or None (no split known)."""
+    `corrupt_flags` the [m] corrupt-slot flags or None (no split known).
+    `sign_sums` (optional): an already-accumulated sign-sum tree whose
+    margins the vote actually thresholds — the buffered-async path
+    (fl/buffered.py) hands over its buffer accumulators so the margin
+    histogram describes the BUFFERED electorate, not just this tick's;
+    `vote_range` then widens the bucketization range to that
+    electorate's maximum (fl/buffered.vote_range — default: m)."""
     with jax.named_scope("telemetry"):
         m = jax.tree_util.tree_leaves(updates)[0].shape[0]
+        vr = vote_range or m
         if mask is not None:
             from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
                 masking)
@@ -183,16 +195,21 @@ def compute(cfg, updates, lr, agg, mask=None, corrupt_flags=None):
             return out
         counts = jnp.zeros((N_MARGIN_BUCKETS,), jnp.float32)
         margin_sum = jnp.float32(0.0)
-        for u in jax.tree_util.tree_leaves(updates):
-            uf = u.reshape(m, -1).astype(jnp.float32)
-            s = jnp.abs(jnp.sum(jnp.sign(uf), axis=0))
-            c, ms = _bucketize_margins(s, m)
-            counts, margin_sum = counts + c, margin_sum + ms
+        if sign_sums is not None:
+            for s_leaf in jax.tree_util.tree_leaves(sign_sums):
+                c, ms = _bucketize_margins(jnp.abs(s_leaf), vr)
+                counts, margin_sum = counts + c, margin_sum + ms
+        else:
+            for u in jax.tree_util.tree_leaves(updates):
+                uf = u.reshape(m, -1).astype(jnp.float32)
+                s = jnp.abs(jnp.sum(jnp.sign(uf), axis=0))
+                c, ms = _bucketize_margins(s, vr)
+                counts, margin_sum = counts + c, margin_sum + ms
         dots, usq = _cosine_accumulators(
             jax.tree_util.tree_leaves(updates),
             jax.tree_util.tree_leaves(agg), m)
         out.update(_finish_margins(counts, margin_sum,
-                                   _total_coords(updates), m))
+                                   _total_coords(updates), vr))
         corrupt = (jnp.zeros((m,), bool) if corrupt_flags is None
                    else corrupt_flags)
         valid = jnp.ones((m,), bool) if mask is None else mask
@@ -205,7 +222,7 @@ def compute(cfg, updates, lr, agg, mask=None, corrupt_flags=None):
 
 def compute_sharded(cfg, updates_local, lr, agg, axis_name,
                     mask_local=None, mask_full=None, corrupt_full=None,
-                    sign_sums=None):
+                    sign_sums=None, vote_range=None):
     """Telemetry dict inside the shard_mapped round body. `updates_local`
     leaves are this device's [m/d, ...] agent block; `lr`/`agg` are
     replicated trees. Collective cost: three tiny all_gathers under
@@ -216,9 +233,11 @@ def compute_sharded(cfg, updates_local, lr, agg, axis_name,
     on XLA CSE, which the jaxpr contract checker measured never happens
     across channel-id'd all-reduces (the same finding the vote/aggregate
     sharing fixed in PR 4). Without `sign_sums` (RLR off) the psums are
-    issued here and budgeted accordingly."""
+    issued here and budgeted accordingly. `vote_range` widens the
+    margin bucketization for the buffered electorate (see `compute`)."""
     with jax.named_scope("telemetry"):
         m = cfg.agents_per_round
+        vr = vote_range or m
         if mask_local is not None:
             from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
                 masking)
@@ -368,8 +387,11 @@ def emit_scalars(writer, vals, step: int) -> None:
         if not key.startswith(PREFIX):
             continue
         tag = TAGS.get(key, f"Defense/{key[len(PREFIX):]}")
-        if key == "tel_margin_hist":
-            for i, frac in enumerate(vals[key]):
-                writer.scalar(f"{tag}/{i}", float(frac), step)
+        v = vals[key]
+        if getattr(v, "ndim", 0) or isinstance(v, (list, tuple)):
+            # vector series (margin histogram, per-staleness split):
+            # one row per bin, the margin-hist idiom
+            for i, x in enumerate(v):
+                writer.scalar(f"{tag}/{i}", float(x), step)
         else:
-            writer.scalar(tag, float(vals[key]), step)
+            writer.scalar(tag, float(v), step)

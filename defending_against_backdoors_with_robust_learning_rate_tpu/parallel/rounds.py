@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+    buffered)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     bind_data, make_block_trainer, make_chained)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
@@ -411,6 +413,94 @@ def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
     return new_params, info
 
 
+def _bucket_async_contribs(cfg, params, updates, szs, mask_local, T_loc,
+                           d, ax):
+    """Buffered-async contributions through the bucketed collective shape
+    (`--agg_mode buffered --agg_layout bucket`): the tick's per-level
+    partial sums flatten into level-stacked rows of the bucket layout,
+    ride ONE `psum_scatter` per bucket, and ONE `all_gather` reconstructs
+    the globally-summed rows, which unflatten back into the contribution
+    trees the shared replicated fold consumes (fl/buffered.fold_commit).
+
+    Collective count: n_buckets reduce-scatters + 1 all_gather (+ the
+    caller's packed scalar psum) — within the sync bucket plan's pinned
+    budget (reduce-scatter 1, all_gather 1, psum 2 on the flagship). The
+    gather carries `levels x quantities` rows instead of sync's one
+    LR-scaled row; a real pod deployment would fold pending state on the
+    scattered shard to keep wire bytes flat — simulation-side this keeps
+    the buffer state layout-uniform with the leaf path (one checkpoint /
+    carry shape per config), which the crash-exact drill depends on."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+        masking)
+    avg = cfg.aggr == "avg"
+    sgn = buffered.wants_sign(cfg)
+    layout = buckets.layout_for_stacked(updates, d)
+    if mask_local is not None:
+        updates = masking.zero_masked(updates, mask_local)
+    flat = buckets.flatten_stacked(layout, updates)      # [mb, padded]
+    w = szs.astype(jnp.float32)
+    sw = buffered._level_weights(cfg, T_loc)
+    if sw is not None:
+        w = w * sw
+    sflat = jnp.sign(flat) if sgn else None
+    avg_rows, sign_rows, cnt, wsum = [], [], [], []
+    if T_loc is None:
+        valid = (mask_local if mask_local is not None
+                 else jnp.ones(w.shape, bool))
+        wv = jnp.where(valid, w, 0.0)
+        cnt.append(masking.count_f32(valid))
+        if avg:
+            wsum.append(jnp.sum(wv))
+            avg_rows.append(jnp.sum(flat * wv[:, None], axis=0))
+        if sgn:
+            sign_rows.append(jnp.sum(sflat, axis=0))
+    else:
+        S = buffered.max_staleness(cfg)
+        valid = (mask_local if mask_local is not None
+                 else jnp.ones(T_loc.shape, bool))
+        for s in range(S + 1):
+            lvl = valid & (T_loc == s)
+            wl = jnp.where(lvl, w, 0.0)
+            cnt.append(masking.count_f32(lvl))
+            if avg:
+                wsum.append(jnp.sum(wl))
+                avg_rows.append(jnp.sum(flat * wl[:, None], axis=0))
+            if sgn:
+                sign_rows.append(
+                    jnp.sum(jnp.where(lvl[:, None], sflat, 0.0), axis=0))
+    rows = jnp.stack(avg_rows + sign_rows)               # [R, padded]
+    scat = jnp.concatenate([
+        jax.lax.psum_scatter(
+            rows[:, b * layout.bucket:(b + 1) * layout.bucket],
+            ax, scatter_dimension=1, tiled=True)
+        for b in range(layout.n_buckets)], axis=1)       # [R, device_len]
+    gathered = jax.lax.all_gather(scat, ax, axis=0)      # [d, R, dl]
+    treedef = jax.tree_util.tree_structure(params)
+
+    def row_tree(r):
+        return buckets.unflatten(
+            layout, buckets.gathered_to_flat(layout, gathered[:, r, :]),
+            treedef)
+
+    n_lvl = len(avg_rows) if avg else len(sign_rows)
+    trees = {}
+    stack = jax.tree_util.tree_map
+    if T_loc is None:
+        if avg:
+            trees["buf"] = row_tree(0)
+        if sgn:
+            trees["sign"] = row_tree(len(avg_rows))
+        return (trees, cnt[0], wsum[0] if avg else None)
+    if avg:
+        trees["buf"] = stack(lambda *xs: jnp.stack(xs),
+                             *[row_tree(s) for s in range(n_lvl)])
+    if sgn:
+        off = len(avg_rows)
+        trees["sign"] = stack(lambda *xs: jnp.stack(xs),
+                              *[row_tree(off + s) for s in range(n_lvl)])
+    return (trees, jnp.stack(cnt), jnp.stack(wsum) if avg else None)
+
+
 def _sharded_pallas_apply(params, updates, sizes, cfg):
     """Fused server step over the mesh: ONE Pallas pass per device over each
     local [m/d, leaf] update block (partial sign-sum + partial weighted sum,
@@ -515,14 +605,20 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             "re-run with --agg_layout leaf — the per-leaf psum plan "
             "keeps the full lr tree and supports every diagnostic")
 
-    def shard_body(params, imgs, lbls, szs, keys, noise_key, *rest):
+    is_async = buffered.is_buffered(cfg)
+
+    def shard_body(carry, imgs, lbls, szs, keys, noise_key, *rest):
         # trailing replicated inputs, in order: [m] corrupt flags (faults /
         # full telemetry / in-jit attack), the [m] churn availability
         # mask, then the scalar attack-schedule gate — the caller
         # computes the lifecycle draw and the schedule gate OUTSIDE
         # shard_map (they need the sampled ids / round index) and they
         # arrive replicated, so neither adds a collective (analysis
-        # *_churn / *_atk_* specs pin this)
+        # *_churn / *_atk_* specs pin this).
+        # Buffered mode: the lead argument is the (params, buffer-state)
+        # carry — both replicated; the fold is elementwise post-psum
+        # (fl/buffered.py), so the collective plan is the sync family's.
+        params, astate = carry if is_async else (carry, None)
         idx = 0
         corrupt_full = churn_full = atk_active = None
         if take_flags:
@@ -573,6 +669,68 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             mask_full = (churn_full if mask_full is None
                          else mask_full & churn_full)
             mask_local = local(mask_full)
+        if is_async:
+            # buffered-async tail: this tick's per-level contributions
+            # ride the sync plan's collectives (per-leaf psums on the
+            # leaf layout, per-bucket reduce-scatter + one all_gather on
+            # the bucket layout; the tiny count/weight/loss lanes pack
+            # into ONE vector psum), then the shared replicated fold
+            # advances the carried buffer (fl/buffered.fold_commit —
+            # zero collectives of its own, pinned by the *_async specs)
+            with jax.named_scope("buffered_fold"):
+                T_full = buffered.latency(
+                    cfg, noise_key,
+                    draw.straggler if draw is not None else None)
+                T_loc = local(T_full) if T_full is not None else None
+                loss_local = jnp.mean(losses)
+                if _bucket_applicable(cfg):
+                    g_trees, cnt_l, wsum_l = _bucket_async_contribs(
+                        cfg, params, updates, szs, mask_local, T_loc, d,
+                        AGENTS_AXIS)
+                else:
+                    c = buffered.tick_contributions(cfg, updates, szs,
+                                                    mask_local, T_loc)
+                    g_trees = {
+                        k: tree.map(
+                            lambda x: jax.lax.psum(x, AGENTS_AXIS), c[k])
+                        for k in ("buf", "sign") if k in c}
+                    cnt_l, wsum_l = c["cnt"], c.get("wsum")
+                lanes = [jnp.atleast_1d(cnt_l)]
+                if wsum_l is not None:
+                    lanes.append(jnp.atleast_1d(wsum_l))
+                lanes.append(loss_local[None])
+                packed = jax.lax.psum(jnp.concatenate(lanes), AGENTS_AXIS)
+                n1 = lanes[0].shape[0]
+                contribs = dict(g_trees)
+                contribs["cnt"] = packed[:n1] if n1 > 1 else packed[0]
+                if wsum_l is not None:
+                    contribs["wsum"] = (packed[n1:2 * n1] if n1 > 1
+                                        else packed[1])
+                # the loss lane rides the packed psum: psum/d is exactly
+                # pmean's arithmetic, so the budget stays the sync plan's
+                loss = packed[-1] / d
+                new_params, new_astate, lr, agg, a_extras, vote_sign = \
+                    buffered.fold_commit(cfg, params, astate, contribs,
+                                         noise_key, m)
+            extras = dict(a_extras)
+            if faults_on:
+                extras.update(fmodel.fault_scalars(draw, mask_full))
+                if churn_full is not None and cfg.churn_enabled:
+                    extras["churn_away"] = churn_mod.churn_away(churn_full)
+            elif churn_full is not None and cfg.churn_enabled:
+                extras.update(churn_mod.churn_only_scalars(churn_full,
+                                                           mask_full))
+            if cfg.telemetry != "off":
+                from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                    telemetry)
+                extras.update(telemetry.compute_sharded(
+                    cfg, updates,
+                    lr if cfg.robustLR_threshold > 0 else None, agg,
+                    AGENTS_AXIS, mask_local=mask_local,
+                    mask_full=mask_full, corrupt_full=corrupt_full,
+                    sign_sums=vote_sign,
+                    vote_range=buffered.vote_range(cfg)))
+            return (new_params, new_astate), loss, extras
         if _pallas_applicable(cfg):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
             loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
@@ -648,6 +806,8 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
         return new_params, loss, extras
 
     extras_specs = {}
+    if is_async:
+        extras_specs.update({k: P() for k in buffered.ASYNC_INFO_KEYS})
     if faults_on or (churn_on and cfg.churn_enabled):
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
             FAULT_INFO_KEYS)
@@ -761,6 +921,12 @@ def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):
         raise ValueError(
             "client churn (--churn_available < 1) is not supported in "
             "host-sampled mode; run device-resident (--host_sampled off)")
+    if buffered.is_buffered(cfg):
+        # same contract as the single-device host step (fl/rounds)
+        raise ValueError(
+            "--agg_mode buffered is not supported in host-sampled mode; "
+            "run device-resident (--host_sampled off) or cohort-sampled "
+            "(--cohort_sampled on)")
     if attack_registry.needs_round(cfg):
         # same contract as the single-device host step: no round channel
         # for the schedule gate (fl/rounds.make_host_step)

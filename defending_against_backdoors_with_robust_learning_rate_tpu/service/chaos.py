@@ -13,6 +13,15 @@ retry) and/or ``:arg`` (seconds for the slow/wedge actions)::
 
     kill@7                 SIGKILL self right after round 7's dispatch
                            (mid-round w.r.t. the eval/checkpoint boundary)
+    kill_midbuf@7          the buffered-aggregation drill (ISSUE 12):
+                           same SIGKILL, declared as a MID-BUFFER kill —
+                           the driver refuses the spec unless --agg_mode
+                           buffered is on, and the recovery acceptance is
+                           that the carried buffer/staleness state rides
+                           the digest-verified checkpoint back byte-
+                           exactly (pick a round where the commit cadence
+                           leaves the buffer non-empty at the preceding
+                           checkpoint, e.g. K=2m with an odd --snap)
     wedge@3                dispatch attempt 1 of round 3 raises a
     wedge@3x2              transient UNAVAILABLE ChaosError (x2: first two
                            attempts — exercises repeated backoff)
@@ -49,8 +58,8 @@ from typing import Dict, List, Optional
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.checkpoint import (
     atomic_write_text)
 
-ACTIONS = ("kill", "wedge", "poison", "poison_eval", "slow_eval",
-           "wedge_drain", "corrupt_ckpt")
+ACTIONS = ("kill", "kill_midbuf", "wedge", "poison", "poison_eval",
+           "slow_eval", "wedge_drain", "corrupt_ckpt")
 
 _TERM_RE = re.compile(
     r"^(?P<action>[a-z_]+)@(?P<round>\d+)"
@@ -141,14 +150,22 @@ class Chaos:
 
     def maybe_kill(self, rnd: int) -> None:
         """Called after round ``rnd``'s dispatch: kill -9 mid-round. Marks
-        state FIRST (the next life must not re-fire while replaying)."""
-        inj = self._due("kill", rnd)
+        state FIRST (the next life must not re-fire while replaying).
+        ``kill_midbuf`` is the buffered-aggregation variant — same kill,
+        but the driver has already validated the mode (serve refuses the
+        spec on a sync run: a 'mid-buffer' drill without a buffer would
+        silently test nothing)."""
+        inj = self._due("kill", rnd) or self._due("kill_midbuf", rnd)
         if inj is None:
             return
         self._mark(inj)
         print(f"[chaos] kill -9 after round {rnd}'s dispatch "
               f"({inj.key})", flush=True)
         os.kill(os.getpid(), signal.SIGKILL)
+
+    def requires_buffered(self) -> bool:
+        """Whether the spec contains a buffered-mode-only drill."""
+        return any(inj.action == "kill_midbuf" for inj in self.injections)
 
     def on_eval(self, rnd: int) -> None:
         inj = self._due("slow_eval", rnd)

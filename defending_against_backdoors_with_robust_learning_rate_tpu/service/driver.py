@@ -182,6 +182,11 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         cfg.chaos, state_path=(os.path.join(cfg.log_dir, "chaos_state.json")
                                if cfg.chaos else None))
     if chaos.active:
+        if chaos.requires_buffered() and cfg.agg_mode != "buffered":
+            raise ValueError(
+                "--chaos kill_midbuf is the buffered-aggregation drill "
+                "(the kill must land on a non-empty carried buffer); run "
+                "with --agg_mode buffered, or use the plain kill@N")
         print(f"[service] chaos injections armed: {cfg.chaos}")
 
     adapt = _adapt

@@ -101,6 +101,10 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
             row: Dict[str, Any] = {"cell": cell["name"],
                                    "overrides": cell["overrides"],
                                    "started": time.time()}
+            if "meta" in cell:
+                # caller-computed cell annotations (e.g. the scenario
+                # sweep's simulated-clock cost) ride the row verbatim
+                row["meta"] = cell["meta"]
             t0 = time.perf_counter()
             try:
                 if service_mode:

@@ -38,6 +38,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate impor
     make_eval_fn, pad_eval_set)
 from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
     registry as attack_registry)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+    buffered as buffered_mod)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     CHAINED_INFO_KEYS, FAULT_INFO_KEYS, host_takes_flags, make_round_fn,
     make_round_fn_host, step_takes_round)
@@ -194,6 +196,14 @@ class RoundEngine:
         atk_banner = attack_registry.banner(cfg)
         if atk_banner:
             print(atk_banner)
+        # buffered-async validation (fl/buffered.py: order-statistic
+        # aggregators, diagnostics, pallas, host-sampled — each refusal
+        # names its remediation)
+        buffered_mod.check(cfg)
+        self.async_mode = async_mode = buffered_mod.is_buffered(cfg)
+        async_banner = buffered_mod.banner(cfg)
+        if async_banner:
+            print(async_banner)
         impl = apply_rng_impl(cfg.rng_impl)
         if impl != "threefry2x32":
             print(f"[rng] {impl} bit generator")
@@ -652,6 +662,30 @@ class RoundEngine:
                   + (", host-sampled blocks)" if host_chained_fn is not None
                      else ")"))
 
+        if async_mode and host_mode:
+            raise ValueError(
+                "--agg_mode buffered is not supported in host-sampled "
+                "mode (this dataset is above the device-resident budget "
+                "and the host step has no channel for the arrival draw); "
+                "run cohort-sampled (--cohort_sampled on) so the round "
+                "program owns the cohort, or --agg_mode sync")
+        if async_mode and jax.process_count() > 1:
+            raise NotImplementedError(
+                "--agg_mode buffered is single-process for now — the "
+                "carried buffer state is not yet multi-host replicated; "
+                "run --agg_mode sync on multi-process jobs")
+        if async_mode:
+            # the engine's "params" slot becomes the (params, buffer)
+            # carry: checkpointing, AOT avals, donation and the chained
+            # scan all treat it as one pytree, which is what makes a
+            # mid-buffer kill recover crash-exactly — the buffer rides
+            # the digest-verified checkpoint like params do. Per-bin
+            # telemetry accumulators ride the vmap paths only
+            # (fl/buffered.init_state; the sharded paths degrade the
+            # per-staleness split rather than paying per-bin collectives).
+            params = (params, buffered_mod.init_state(
+                cfg, params, per_bin=(n_mesh == 1)))
+
         if cfg.faults_enabled:
             print(f"[faults] dropout={cfg.dropout_rate} "
                   f"straggler={cfg.straggler_rate}@{cfg.straggler_epochs}ep "
@@ -759,6 +793,10 @@ class RoundEngine:
         if bank is not None and jax.process_count() == 1 and n_mesh == 1:
             ab = compile_cache.abstractify
             p_aval, k_aval = ab(params), ab(base_key)
+            # eval programs take the BARE model params — in buffered mode
+            # `params` is the (params, buffer-state) carry and handing
+            # that aval to eval would lower model.apply over a tuple
+            mp_aval = ab(params[0]) if async_mode else p_aval
             ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
             # churn — and scheduled-attack — round programs take the
             # round index as a traced int32 scalar (single source
@@ -837,11 +875,11 @@ class RoundEngine:
                     if fn is not None:
                         chained_fn = _bind_compiled(fn, chained_fn.data)
             fn = _adopt_aot(bank, cfg, "eval_val", eval_fn,
-                            (p_aval,) + ab(val))
+                            (mp_aval,) + ab(val))
             if fn is not None:
                 eval_val_fn = fn
             fn = _adopt_aot(bank, cfg, "eval_poison", eval_fn,
-                            (p_aval,) + ab(pval))
+                            (mp_aval,) + ab(pval))
             if fn is not None:
                 eval_pval_fn = fn
 
@@ -911,6 +949,14 @@ class RoundEngine:
     def chaining(self) -> bool:
         return (self._chained_fn is not None
                 or self._host_chained_fn is not None)
+
+    @property
+    def model_params(self):
+        """The bare model parameters: in buffered-async mode the engine's
+        ``params`` slot holds the (params, buffer-state) carry
+        (fl/buffered.py) — eval, profiling and the summary read the model
+        half through this property."""
+        return self.params[0] if self.async_mode else self.params
 
     def schedule(self):
         """The one-shot dispatch plan from the engine's (restored) start
@@ -1082,10 +1128,10 @@ class RoundEngine:
         # overlapped with the round r+1 training block
         with tracer.span("eval/val_dispatch", round=rnd):
             val_loss_d, val_acc_d, per_class_d = self._eval_val_fn(
-                self.params, *self.val)
+                self.model_params, *self.val)
         with tracer.span("eval/poison_dispatch", round=rnd):
             poison_loss_d, poison_acc_d, _ = self._eval_pval_fn(
-                self.params, *self.pval)
+                self.model_params, *self.pval)
         vals.update(val_loss=val_loss_d, val_acc=val_acc_d,
                     base_acc=per_class_d[cfg.base_class],
                     poison_loss=poison_loss_d,
@@ -1095,6 +1141,10 @@ class RoundEngine:
             vals.update({k: info[k] for k in FAULT_INFO_KEYS})
         if "churn_away" in info:
             vals["churn_away"] = info["churn_away"]
+        if "async_fill" in info:
+            # buffered-aggregation observability (fl/buffered.py)
+            vals.update({k: info[k]
+                         for k in buffered_mod.ASYNC_INFO_KEYS})
         # in-jit defense telemetry rides the same (async) fetch
         vals.update({k: info[k] for k in info if k.startswith("tel_")})
         if self.drain is not None:
@@ -1155,6 +1205,15 @@ class RoundEngine:
         if "churn_away" in vals:
             writer.scalar("Churn/Sampled_Away",
                           float(vals["churn_away"]), ernd)
+        if "async_fill" in vals:
+            # buffered-mode observability: how full the buffer ran, and
+            # the staleness mix it accumulated since the last commit
+            writer.scalar("Async/Buffer_Fill",
+                          float(vals["async_fill"]), ernd)
+            writer.scalar("Async/Committed",
+                          float(vals["async_committed"]), ernd)
+            for i, c in enumerate(vals["async_stale_hist"]):
+                writer.scalar(f"Async/Staleness_Hist/{i}", float(c), ernd)
         # Defense/* telemetry scalars (obs/telemetry.py), shared emit path
         # so sync and async streams stay bit-identical
         obs_telemetry.emit_scalars(writer, vals, ernd)
@@ -1278,7 +1337,7 @@ class RoundEngine:
             summary["steady_rounds_per_sec"] = (
                 (mstate["r_steady_end"] - mstate["r_steady"])
                 / max(mstate["t_steady_end"] - mstate["t_steady"], 1e-9))
-        summary["params"] = param_count(self.params)
+        summary["params"] = param_count(self.model_params)
         print("Training has finished!")
         print(f"[throughput] {summary['rounds_per_sec']:.3f} rounds/sec "
               f"({self.rounds_done} rounds in {elapsed:.1f}s)"

@@ -211,11 +211,31 @@ def resolved_train_layout(cfg) -> str:
 
 
 def family_suffix(cfg) -> str:
-    """Program-family name suffix for the resolved training layout:
-    megabatch families are DISTINCT programs with distinct names
-    (`round_mb`, `chained_mb`, ...) so manifests, contracts and driver
-    logs never conflate the two layouts."""
-    return "_mb" if resolved_train_layout(cfg) == "megabatch" else ""
+    """Program-family name suffix for the aggregation mode + resolved
+    training layout: buffered-async families (`round_async`, ...,
+    fl/buffered.py) and megabatch families (`round_mb`, ...) are DISTINCT
+    programs with distinct names — and they compose (`round_async_mb`) —
+    so manifests, contracts and driver logs never conflate them."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+        buffered)
+    sfx = "_async" if buffered.is_buffered(cfg) else ""
+    if resolved_train_layout(cfg) == "megabatch":
+        sfx += "_mb"
+    return sfx
+
+
+def carry_aval(cfg, params_aval, sharded: bool = False):
+    """The round program's lead-argument aval: bare params (sync), or the
+    (params, buffer-state) carry (buffered mode, fl/buffered.py). The
+    ``sharded`` flag mirrors the per-bin telemetry layout decision — the
+    vmap paths carry the per-staleness accumulators under full telemetry,
+    the sharded paths degrade that split and carry none."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+        buffered)
+    if not buffered.is_buffered(cfg):
+        return params_aval
+    return (params_aval,
+            buffered.state_avals(cfg, params_aval, per_bin=not sharded))
 
 
 def fingerprint(cfg, family: str, example_args) -> str:
@@ -513,6 +533,9 @@ def plan_programs(cfg, model, norm, fed,
     image_shape = fed.train.images.shape[2:]
     params_aval = jax.eval_shape(
         lambda k: init_params(model, image_shape, k), jax.random.PRNGKey(0))
+    # buffered mode: round programs take the (params, buffer-state)
+    # carry as their lead argument; eval programs keep bare params
+    lead_aval = carry_aval(cfg, params_aval)
     key_aval = abstractify(jax.random.PRNGKey(0))
     data_avals = abstractify((fed.train.images, fed.train.labels,
                               fed.train.sizes))
@@ -534,12 +557,12 @@ def plan_programs(cfg, model, norm, fed,
         specs.append(ProgramSpec(
             "round_cohort" + sfx,
             make_cohort_round_fn(plain, model, norm),
-            (params_aval, key_aval, rnd_aval) + shard_avals))
+            (lead_aval, key_aval, rnd_aval) + shard_avals))
         if cfg.diagnostics:
             specs.append(ProgramSpec(
                 "round_cohort_diag",
                 make_cohort_round_fn(cfg, model, norm),
-                (params_aval, key_aval, rnd_aval) + shard_avals))
+                (lead_aval, key_aval, rnd_aval) + shard_avals))
         if chain_n > 1:
             block_avals = tuple(
                 jax.ShapeDtypeStruct((chain_n,) + a.shape, a.dtype)
@@ -547,7 +570,7 @@ def plan_programs(cfg, model, norm, fed,
             specs.append(ProgramSpec(
                 "chained_cohort" + sfx,
                 make_chained_cohort_round_fn(plain, model, norm),
-                (params_aval, key_aval, ids_aval) + block_avals))
+                (lead_aval, key_aval, ids_aval) + block_avals))
     elif host_mode:
         shard_avals = tuple(
             jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
@@ -579,18 +602,18 @@ def plan_programs(cfg, model, norm, fed,
         specs.append(ProgramSpec(
             "round" + sfx,
             make_round_fn(plain, model, norm, *data_avals).jitted,
-            (params_aval, key_aval) + lead + data_avals))
+            (lead_aval, key_aval) + lead + data_avals))
         if cfg.diagnostics:
             specs.append(ProgramSpec(
                 "round_diag",
                 make_round_fn(cfg, model, norm, *data_avals).jitted,
-                (params_aval, key_aval) + lead + data_avals))
+                (lead_aval, key_aval) + lead + data_avals))
         if chain_n > 1:
             specs.append(ProgramSpec(
                 "chained" + sfx,
                 make_chained_round_fn(plain, model, norm,
                                       *data_avals).jitted,
-                (params_aval, key_aval, ids_aval) + data_avals))
+                (lead_aval, key_aval, ids_aval) + data_avals))
 
     eval_fn = make_eval_fn(model, norm, cfg.n_classes)
     for family, (imgs, lbls) in (
@@ -627,6 +650,10 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
     image_shape = fed.train.images.shape[2:]
     params_aval = jax.eval_shape(
         lambda k: init_params(model, image_shape, k), jax.random.PRNGKey(0))
+    # buffered mode: the sharded round programs take the (params,
+    # buffer-state) carry — the sharded layout never carries the per-bin
+    # telemetry accumulators (fl/buffered.init_state)
+    lead_aval = carry_aval(cfg, params_aval, sharded=True)
     key_aval = abstractify(jax.random.PRNGKey(0))
     data_avals = abstractify((fed.train.images, fed.train.labels,
                               fed.train.sizes))
@@ -643,7 +670,7 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
         specs.append(ProgramSpec(
             "round_sharded_cohort" + sfx,
             make_sharded_cohort_round_fn(plain, model, norm, mesh),
-            (params_aval, key_aval, rnd_aval) + shard_avals))
+            (lead_aval, key_aval, rnd_aval) + shard_avals))
         return specs
     if host_mode:
         shard_avals = tuple(
@@ -662,20 +689,20 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
         "round_sharded" + sfx,
         make_sharded_round_fn(plain, model, norm, mesh,
                               *data_avals).jitted,
-        (params_aval, key_aval) + lead + data_avals))
+        (lead_aval, key_aval) + lead + data_avals))
     if cfg.diagnostics:
         specs.append(ProgramSpec(
             "round_sharded_diag",
             make_sharded_round_fn(cfg, model, norm, mesh,
                                   *data_avals).jitted,
-            (params_aval, key_aval) + lead + data_avals))
+            (lead_aval, key_aval) + lead + data_avals))
     if chain_n > 1:
         ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
         specs.append(ProgramSpec(
             "chained_sharded" + sfx,
             make_sharded_chained_round_fn(plain, model, norm, mesh,
                                           *data_avals).jitted,
-            (params_aval, key_aval, ids_aval) + data_avals))
+            (lead_aval, key_aval, ids_aval) + data_avals))
     return specs
 
 

@@ -82,6 +82,16 @@ def run_name(cfg) -> str:
         if not attack_schedule.is_trivial(cfg):
             atk += (f"s{cfg.attack_start}e{cfg.attack_every}"
                     + (f"t{cfg.attack_stop}" if cfg.attack_stop else ""))
+    agm = ""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+        buffered)
+    if buffered.is_buffered(cfg):
+        # buffered-aggregation cell: two runs differing only in commit
+        # threshold / staleness weighting / latency range must not share
+        # a run dir (the sweep-cell collision class PR 3 fixed); sync
+        # runs stay cell-free so every historical dir is preserved
+        agm = (f"-agm:bufK{buffered.buffer_k(cfg)}"
+               f"a{cfg.async_staleness_exp}S{cfg.async_max_staleness}")
     layout = ""
     if compile_cache.resolved_train_layout(cfg) == "megabatch":
         # training-layout cell (ISSUE 10): megabatch results are only
@@ -95,7 +105,7 @@ def run_name(cfg) -> str:
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
             f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}"
-            f"{faults}{churn}{cohort}{atk}{layout}")
+            f"{faults}{churn}{cohort}{atk}{agm}{layout}")
 
 
 class NullWriter:

@@ -16,13 +16,22 @@ snapshot (flip fraction, vote-margin histogram, cosine split) — and a
 failed cell is recorded with its error and SKIPPED: one poisoned cell
 never aborts the matrix.
 
-Axes (comma lists; see ATTACKS/RULES/FAULTS for the vocabulary)::
+Axes (comma lists; see attacks_vocab/rules_vocab/FAULTS/regimes_vocab)::
 
     python scripts/sweep_scenarios.py                       # 12-cell default
     python scripts/sweep_scenarios.py \
         --attacks static,boost,signflip,dba,boost_late \
         --rules avg,rlr,sign_rlr,comed,trmean,krum,rfa \
         --faults none,drop30 --rounds 50
+
+Asynchronous regimes (ISSUE 12, fl/buffered.py) — attacks x rules x
+staleness in one sweep; every row carries a ``meta.sim_ticks`` simulated
+clock (a sync round pays 1 + the slowest sampled client's latency, a
+buffered tick pays 1)::
+
+    python scripts/sweep_scenarios.py \
+        --attacks boost,signflip --rules avg,rlr \
+        --faults strag50 --regimes sync,buf_k2,buf_k4
 
 CI-scale smoke (synthetic data, seconds per cell)::
 
@@ -31,9 +40,9 @@ CI-scale smoke (synthetic data, seconds per cell)::
         --faults none
 
 Row schema (the queue's row shape, service/queue.py): {"cell":
-"<attack>|<rule>|<fault>", "overrides", "ok", "summary": {val_acc,
-poison_acc, ..., "defense": {tel_*}}, "wall_s"} — the axis names are
-the "|"-separated components of "cell".
+"<attack>|<rule>|<fault>|<regime>", "overrides", "ok", "summary":
+{val_acc, poison_acc, ..., "defense": {tel_*}}, "meta": {"sim_ticks"},
+"wall_s"} — the axis names are the "|"-separated components of "cell".
 """
 
 import argparse
@@ -94,12 +103,56 @@ FAULTS = {
     "drop50": {"dropout_rate": 0.5, "faults_spare_corrupt": True},
     # fair dropout control: attackers drop at the same rate
     "drop30_fair": {"dropout_rate": 0.3},
+    # straggler regimes (ISSUE 12): in sync mode a straggler truncates
+    # its epochs; in buffered mode the SAME rate drives the arrival-
+    # latency draw — the staleness source for the async regimes below
+    "strag30": {"straggler_rate": 0.3},
+    "strag50": {"straggler_rate": 0.5},
 }
 
 
-def build_cells(attack_names, rule_names, fault_names, boost, rounds, thr):
+def regimes_vocab(m: int):
+    """Aggregation-mode regimes (ISSUE 12, fl/buffered.py): sync = the
+    historical barrier; buffered commits every K arrivals with a
+    staleness-weighted buffer. K derives from the cohort size m so the
+    named regimes mean the same thing at any scale."""
+    return {
+        "sync": {},
+        "buf_k2": {"agg_mode": "buffered",
+                   "async_buffer_k": max(1, m // 2)},
+        "buf_k4": {"agg_mode": "buffered",
+                   "async_buffer_k": max(1, m // 4)},
+    }
+
+
+def sim_ticks(cfg_base, overrides, rounds: int) -> float:
+    """Simulated duration of one cell on the tick clock: a buffered tick
+    costs 1; a sync round barriers on the slowest sampled client, so it
+    costs 1 + max(latency draw) — integrated from the host mirror of the
+    in-program draw (fl/buffered.host_latency_draw), which is what makes
+    'buffered makes progress where the sync barrier waits' a measured
+    number in the output rows."""
+    cfg = cfg_base.replace(**overrides)
+    if cfg.agg_mode == "buffered" or cfg.straggler_rate <= 0:
+        return float(rounds)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+        buffered)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    cohort = compile_cache.is_cohort_mode(cfg)   # key-derivation mirror
+    total = 0.0
+    for rnd in range(1, rounds + 1):
+        total += 1.0 + float(
+            buffered.host_latency_draw(cfg, rnd, seed=cfg.seed,
+                                       cohort=cohort).max())
+    return total
+
+
+def build_cells(attack_names, rule_names, fault_names, regime_names,
+                boost, rounds, thr, m):
     attacks = attacks_vocab(boost, rounds)
     rules = rules_vocab(thr)
+    regimes = regimes_vocab(m)
     cells = []
     for a in attack_names:
         if a not in attacks:
@@ -113,10 +166,16 @@ def build_cells(attack_names, rule_names, fault_names, boost, rounds, thr):
                 if f not in FAULTS:
                     raise SystemExit(f"unknown fault regime {f!r}; "
                                      f"choose from {sorted(FAULTS)}")
-                cells.append({
-                    "name": f"{a}|{r}|{f}",
-                    "overrides": {**attacks[a], **rules[r], **FAULTS[f]},
-                })
+                for g in regime_names:
+                    if g not in regimes:
+                        raise SystemExit(
+                            f"unknown agg regime {g!r}; choose from "
+                            f"{sorted(regimes)}")
+                    cells.append({
+                        "name": f"{a}|{r}|{f}|{g}",
+                        "overrides": {**attacks[a], **rules[r],
+                                      **FAULTS[f], **regimes[g]},
+                    })
     return cells
 
 
@@ -130,6 +189,12 @@ def main(argv=None):
                          "(see rules_vocab)")
     ap.add_argument("--faults", default="none,drop30",
                     help="comma list of fault regimes (see FAULTS)")
+    ap.add_argument("--regimes", default="sync",
+                    help="comma list of aggregation-mode regimes "
+                         "(regimes_vocab: sync, buf_k2 = buffered with "
+                         "K=m/2, buf_k4 = K=m/4); pair the buffered "
+                         "regimes with a strag* fault regime so the "
+                         "staleness source is live")
     ap.add_argument("--boost", type=float, default=8.0,
                     help="attack_boost for the boosted scenarios "
                          "(~cohort size replaces the average)")
@@ -186,23 +251,35 @@ def main(argv=None):
 
     split = lambda s: [x.strip() for x in s.split(",") if x.strip()]  # noqa: E731
     cells = build_cells(split(args.attacks), split(args.rules),
-                        split(args.faults), args.boost, args.rounds, thr)
+                        split(args.faults), split(args.regimes),
+                        args.boost, args.rounds, thr,
+                        base.agents_per_round)
+    for cell in cells:
+        # the simulated tick clock: sync cells pay 1 + max(latency) per
+        # round (the straggler barrier), buffered cells pay 1 per tick —
+        # recorded per row so val-acc-vs-sim-time is plottable from the
+        # JSONL alone
+        cell["meta"] = {"sim_ticks": sim_ticks(base, cell["overrides"],
+                                               args.rounds)}
     injected = None
     if args.inject_bad_cell:
         injected = {"name": "injected|bogus|none",
                     "overrides": {"aggr": "bogus_rule"}}
         cells.append(injected)
     print(f"[scenarios] {len(cells)} cells: {args.attacks} x {args.rules} "
-          f"x {args.faults} (boost {args.boost}, thr {thr}) -> {args.out}")
+          f"x {args.faults} x {args.regimes} (boost {args.boost}, "
+          f"thr {thr}) -> {args.out}")
 
     rows = run_queue(base, cells, results_path=args.out)
     ok = [r for r in rows if r["ok"]]
     for r in rows:
         if r["ok"]:
             summ = r.get("summary", {})
-            print(f"[scenarios] {r['cell']:<40} "
+            sim = (r.get("meta") or {}).get("sim_ticks")
+            print(f"[scenarios] {r['cell']:<44} "
                   f"val={summ.get('val_acc')} "
-                  f"poison={summ.get('poison_acc')}")
+                  f"poison={summ.get('poison_acc')}"
+                  + (f" sim_ticks={sim:.0f}" if sim else ""))
         else:
             print(f"[scenarios] {r['cell']:<40} FAILED: {r.get('error')}")
     expected_ok = len(cells) - (1 if injected else 0)

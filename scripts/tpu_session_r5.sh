@@ -252,6 +252,20 @@ else
     say "WARN: bf16 train-layout A/B rc=$?"
 fi
 
+say "step 6b: buffered-async A/B (--agg_mode both, ISSUE 12 — BENCH_NOTES r13)"
+# buffered ticks/sec vs sync rounds/sec: the K=m cell judges the pure
+# mode overhead (r13 acceptance: <=3%), the 30%/50% straggler cells put
+# the production-shape comparison on the record (sync pays the barrier
+# on the simulated clock; the JSON's agg_mode_ab block carries all
+# five measurements)
+if run_bench logs/bench_r5_agg_mode.txt --agg_mode both; then
+    tail -1 logs/bench_r5_agg_mode.txt > BENCH_TPU_r05_agg_mode.json
+    say "agg-mode A/B: $(cat BENCH_TPU_r05_agg_mode.json)"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: agg-mode A/B rc=$?"
+fi
+
 say "step 7/7: figures refresh"
 # NOT counted in SUCCESSES: plot_curves re-renders from a pre-existing
 # results.json, so it succeeds even when every measurement step failed —

@@ -556,3 +556,42 @@ def test_cli_json_clean_tree():
 def test_cli_rejects_unknown_rules():
     r = _run_cli(["--rules", "nope"])
     assert r.returncode == 2
+
+
+def test_async_budgets_and_baseline_pins():
+    """ISSUE-12 acceptance: the buffered-async families keep each mode's
+    pinned plan — avg+RLR within the 2L+2 psum budget (measured 2L+1:
+    the packed count/weight/loss lane replaces the weight psum + loss
+    pmean), the bucket plan at reduce-scatter 1 / all_gather 1 / psum 1,
+    faults + the staleness-stacked pending shape still exactly one
+    [m]-bit validation all_gather — and the counts are topology-free
+    (the @16w pod-shape records land via scripts/check_static.py)."""
+    specs = contracts.check_specs()
+    findings, rec = jaxpr_lint.check_family(specs["sharded_rlr_avg_async"])
+    assert findings == []
+    assert rec["collectives"] == {"psum": 17}   # 2L+1 on the 8-leaf CNN
+
+    path = jaxpr_lint.baseline_path(REPO)
+    with open(path) as f:
+        pinned = json.load(f)["families"]
+    for key in ("vmap_rlr_avg_async", "vmap_rlr_avg_async_mb",
+                "sharded_rlr_avg_async", "sharded_rlr_avg_async@16w",
+                "sharded_rlr_sign_async", "sharded_rlr_avg_async_stale",
+                "sharded_rlr_avg_async_faults",
+                "sharded_rlr_avg_bucket_async",
+                "sharded_rlr_avg_bucket_async@16w",
+                "sharded_chained_rlr_avg_async",
+                "sharded_rlr_avg_cohort_async"):
+        assert key in pinned, f"{key} missing from analysis_baseline.json"
+    # the vmap families stay collective-free; counts are topology-free
+    assert pinned["vmap_rlr_avg_async"]["collectives"] == {}
+    assert pinned["sharded_rlr_avg_async@16w"]["collectives"] == \
+        pinned["sharded_rlr_avg_async"]["collectives"] == {"psum": 17}
+    assert pinned["sharded_rlr_sign_async"]["collectives"] == {"psum": 9}
+    assert pinned["sharded_rlr_avg_bucket_async"]["collectives"] == {
+        "all_gather": 1, "psum": 1, "reduce_scatter": 1}
+    # stale (pending-ladder shapes) + faults: exactly one all_gather each
+    for key in ("sharded_rlr_avg_async_stale",
+                "sharded_rlr_avg_async_faults"):
+        assert pinned[key]["collectives"] == {"all_gather": 1,
+                                              "psum": 17}, key

@@ -208,6 +208,11 @@ def test_signflip_flips_rlr_vote_on_toy_electorate():
 
 # ------------------------------------------------------ quick e2e -------
 
+@pytest.mark.slow  # ~35s, the heaviest tier-1 test (ISSUE 12 budget
+# rule: slow-gate BEFORE growing the suite). Cheap twins in tier-1: the
+# toy-electorate vote tests above pin the boost/signflip mechanics
+# per-round, and the CI scenario-smoke job asserts the exact
+# boost-defeats-avg / RLR-holds separation end-to-end on every push.
 def test_boost_defeats_avg_but_rlr_holds():
     """The acceptance pair on a quick CPU config: model-replacement
     boosting drives poison accuracy to ~1 through plain FedAvg, while
@@ -313,15 +318,21 @@ def test_scenario_matrix_cell_builder():
     spec.loader.exec_module(mod)
     cells = mod.build_cells(["static", "boost", "signflip"],
                             ["avg", "rlr"], ["none", "drop30"],
-                            boost=8.0, rounds=20, thr=4)
-    assert len(cells) == 12
+                            ["sync", "buf_k2"],
+                            boost=8.0, rounds=20, thr=4, m=10)
+    assert len(cells) == 24
     names = {c["name"] for c in cells}
-    assert len(names) == 12
+    assert len(names) == 24
     rlr_cell = next(c for c in cells
-                    if c["name"] == "boost|rlr|drop30")
+                    if c["name"] == "boost|rlr|drop30|sync")
     assert rlr_cell["overrides"]["robustLR_threshold"] == 4
     assert rlr_cell["overrides"]["attack_boost"] == 8.0
     assert rlr_cell["overrides"]["dropout_rate"] == 0.3
+    assert "agg_mode" not in rlr_cell["overrides"]
+    buf_cell = next(c for c in cells
+                    if c["name"] == "boost|rlr|drop30|buf_k2")
+    assert buf_cell["overrides"]["agg_mode"] == "buffered"
+    assert buf_cell["overrides"]["async_buffer_k"] == 5   # m // 2
     # every cell's overrides are real Config fields (the queue validates
     # too; catching vocabulary drift here is cheaper)
     import dataclasses
@@ -329,7 +340,11 @@ def test_scenario_matrix_cell_builder():
     for c in cells:
         assert set(c["overrides"]) <= fields, c
     with pytest.raises(SystemExit, match="unknown attack"):
-        mod.build_cells(["bogus"], ["avg"], ["none"], 8.0, 20, 4)
+        mod.build_cells(["bogus"], ["avg"], ["none"], ["sync"],
+                        8.0, 20, 4, 10)
+    with pytest.raises(SystemExit, match="unknown agg regime"):
+        mod.build_cells(["static"], ["avg"], ["none"], ["bogus"],
+                        8.0, 20, 4, 10)
 
 
 # ------------------------------------------- threshold adaptation -------

@@ -384,6 +384,11 @@ def test_chained_faults_match_per_round_dispatch():
 
 # ------------------------------------------------------------ e2e chaos ---
 
+@pytest.mark.slow  # ~26s (ISSUE 12 budget rule: slow-gated behind
+# cheap twins BEFORE the buffered-mode tests grew tier-1). Twins in
+# tier-1: the masking/draw unit tests above pin every fault mechanism,
+# test_driver's smoke runs the driver e2e, and the service chaos drills
+# (tests/test_service.py) run the full faults+recovery composition.
 def test_chaos_run_completes_and_logs_faults(tmp_path):
     """Acceptance E2E: a short fmnist-geometry run with 30% dropout plus a
     corrupt-payload agent completes every round, logs the Faults/* scalars,

@@ -107,6 +107,11 @@ def test_fused_sign_round_matches_jnp_round():
                                    atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # ~11s driver-level twin of the kernel-level parity
+# (ISSUE 12 budget rule). Cheap twins in tier-1:
+# test_fused_sign_round_matches_jnp_round pins the fused kernel against
+# the jnp path at the round level, and the _pallas_applicable gating is
+# unit-pinned — the full-driver composition only re-runs the same two.
 def test_round_with_pallas_matches_default():
     """Full round: --use_pallas output == jnp path output."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
