@@ -251,6 +251,13 @@ def main():
                          "rounds/sec vs buffered ticks/sec at 30%%/50%% "
                          "straggler rates (agg_mode_ab in the output "
                          "JSON; BENCH_NOTES r13)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help=">=2: tenancy A/B (ISSUE 13, tenancy_ab in the "
+                         "output JSON): an equal 16-cell shape-compatible "
+                         "cell list through the serial experiment queue "
+                         "vs the tenant-packed queue at this pack width — "
+                         "cells/hour per arm + the packed/serial speedup "
+                         "(service/tenancy.py)")
     ap.add_argument("--status_file", default="logs/status.json",
                     help="heartbeat path (obs/heartbeat.py) the session "
                          "stall detector reads; empty disables")
@@ -940,6 +947,50 @@ def main():
         log(f"[bench] buffered/sync throughput ratio at K=m: "
             f"{agg_mode_ab['buffered_vs_sync']:.3f}x")
 
+    tenancy_ab_out = None
+    if args.tenants >= 2:
+        # multi-tenant A/B (ISSUE 13, service/tenancy.py): the SAME
+        # 16-cell shape-compatible cell list (seeds x RLR thresholds —
+        # pure per-tenant knobs) through the serial queue and the
+        # tenant-packed queue at --tenants E. Each arm reports wall +
+        # cells/hour; the headline is the packed/serial speedup (the
+        # ROADMAP target is >10x on TPU via the banked *_mt families).
+        hb.update(phase="tenancy_ab", force=True)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service.queue import (
+            run_queue)
+        thr_ab = cfg.robustLR_threshold or 4
+        ab_cells = [{"name": f"s{s}_t{t}",
+                     "overrides": {"seed": s, "robustLR_threshold": t}}
+                    for t in (0, thr_ab) for s in range(8)]
+        ab_cfg = cfg.replace(rounds=2 * chain, snap=chain,
+                             tensorboard=False, profile_rounds=0)
+        tenancy_ab_out = {"cells": len(ab_cells), "tenants": args.tenants,
+                          "rounds_per_cell": ab_cfg.rounds}
+        for arm, E in (("serial", 0), ("packed", args.tenants)):
+            arm_cfg = ab_cfg.replace(log_dir=os.path.join(
+                cfg.log_dir, "tenancy_ab", arm))
+            t_arm = time.perf_counter()
+            rows = run_queue(
+                arm_cfg,
+                [dict(c, overrides=dict(c["overrides"]))
+                 for c in ab_cells],
+                results_path=os.path.join(arm_cfg.log_dir,
+                                          "queue_results.jsonl"),
+                tenants=E)
+            wall = time.perf_counter() - t_arm
+            ok = sum(r["ok"] for r in rows)
+            tenancy_ab_out[arm] = {
+                "ok": ok, "wall_s": round(wall, 2),
+                "cells_per_hour": round(3600.0 * ok / max(wall, 1e-9),
+                                        2)}
+        tenancy_ab_out["speedup"] = round(
+            tenancy_ab_out["packed"]["cells_per_hour"]
+            / max(tenancy_ab_out["serial"]["cells_per_hour"], 1e-9), 3)
+        log(f"[bench] tenancy A/B: serial "
+            f"{tenancy_ab_out['serial']['cells_per_hour']:.1f} vs packed "
+            f"{tenancy_ab_out['packed']['cells_per_hour']:.1f} cells/hour"
+            f" ({tenancy_ab_out['speedup']:.2f}x at E={args.tenants})")
+
     agg_ab_out = None
     if args.agg_layout:
         # sharded-layout A/B (ISSUE 8): the SAME flagship config through
@@ -1105,6 +1156,8 @@ def main():
     out["agg_mode"] = cfg.agg_mode
     if agg_mode_ab is not None:
         out["agg_mode_ab"] = agg_mode_ab
+    if tenancy_ab_out is not None:
+        out["tenancy_ab"] = tenancy_ab_out
     if hbm:
         out["hbm"] = hbm
     # per-phase span aggregates (obs/spans.py): where this bench's wall

@@ -95,6 +95,11 @@ ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
                            "never traced (the make_ builder convention "
                            "false-positives here)",
     },
+    (f"{PKG}/fl/tenancy.py", "knob_vectors"): {
+        "host-sync": "host-side knob-vector construction from Python "
+                     "config scalars at pack-build time (the pallas "
+                     "_fused_leaf idiom); no device value is touched",
+    },
     (f"{PKG}/fl/buffered.py", "host_latency_draw"): {
         "host-sync": "host MIRROR of the in-program arrival draw (the "
                      "churn/cohort mirror idiom): returns numpy for the "
@@ -136,6 +141,10 @@ DONATED_FAMILIES: Tuple[str, ...] = (
     "chained", "chained_mb", "chained_host", "chained_host_mb",
     "chained_cohort", "chained_cohort_mb",
     "chained_sharded", "chained_sharded_mb",
+    # tenant-pack twins (ISSUE 13): the chained scan donates the whole
+    # [E, ...]-stacked parameter carry — without it every dispatched
+    # block would hold two copies of E experiments' params
+    "chained_mt", "chained_mb_mt",
     # buffered-async twins (ISSUE 12): the chained scan donates the whole
     # (params, buffer) carry — without it every dispatched block would
     # hold two copies of the buffer state on top of the params pair
@@ -615,6 +624,48 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         collective_budget={**zero, "psum": 2 * n_leaves + 2,
                            "all_gather": 1},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # multi-tenant tenant packs (ISSUE 13, fl/tenancy.py): the
+    # EXPERIMENT axis folds as a leading [E] dimension — vmap over
+    # tenants INSIDE the shard_map body (parallel/rounds.py
+    # make_sharded_round_fn_mt), so every collective batches over the
+    # tenant axis instead of multiplying: ONE psum of an [E, ...]
+    # payload, not E psums. The acceptance claim is ZERO collectives
+    # beyond each layout's pinned plan at 1/8/16-way — leaf avg+RLR
+    # stays 2L+2 psums, sign+RLR L+1, faults still exactly the one
+    # [m]-bit validation all_gather, and the bucketed reduce-scatter
+    # keeps its 4-collective shape; the vmap tenant family stays
+    # collective-free. Per-tenant knobs are traced [E]-vector inputs and
+    # add nothing to the communication plan.
+    mt = {"tenants": 2}
+    specs["vmap_rlr_avg_mt"] = CheckSpec(
+        name="vmap_rlr_avg_mt", family="round_mt", sharded=False,
+        cfg_overrides=dict(mt), collective_budget=dict(zero))
+    specs["sharded_rlr_avg_mt"] = CheckSpec(
+        name="sharded_rlr_avg_mt", family="round_sharded_mt",
+        sharded=True, cfg_overrides=dict(mt),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_sign_mt"] = CheckSpec(
+        name="sharded_rlr_sign_mt", family="round_sharded_mt",
+        sharded=True,
+        cfg_overrides={**mt, "aggr": "sign", "server_lr": 1.0},
+        collective_budget={**zero, "psum": n_leaves + 1},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+    specs["sharded_rlr_avg_mt_faults"] = CheckSpec(
+        name="sharded_rlr_avg_mt_faults", family="round_sharded_mt",
+        sharded=True,
+        cfg_overrides={**mt, "dropout_rate": 0.3,
+                       "payload_norm_cap": 100.0,
+                       "faults_spare_corrupt": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_mt"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_mt", family="round_sharded_mt",
+        sharded=True, cfg_overrides={**mt, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
     return specs
 
 

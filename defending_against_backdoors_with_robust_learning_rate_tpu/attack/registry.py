@@ -131,17 +131,21 @@ def needs_round(cfg) -> bool:
     return in_jit(cfg) and not schedule.is_trivial(cfg)
 
 
-def update_scale(cfg, corrupt_flags, active):
-    """The strategy's [m] per-row multiplicative scale."""
+def update_scale(cfg, corrupt_flags, active, boost=None):
+    """The strategy's [m] per-row multiplicative scale. ``boost``
+    overrides ``cfg.attack_boost`` with a traced scalar — the
+    multi-tenant pack's per-tenant knob (fl/tenancy.py); None keeps the
+    config constant (the solo paths, program unchanged)."""
     strat = get(cfg)
     if strat.scale_rows is None:
         raise ValueError(f"attack {strat.name!r} has no in-jit update "
                          f"hook")
-    return strat.scale_rows(corrupt_flags, active, cfg.attack_boost)
+    return strat.scale_rows(corrupt_flags, active,
+                            cfg.attack_boost if boost is None else boost)
 
 
 def apply_update_attack(cfg, stacked_updates, corrupt_flags,
-                        active=None):
+                        active=None, boost=None):
     """Apply the in-jit strategy to the [m(/d), ...]-stacked updates.
 
     ``corrupt_flags`` marks which rows hold malicious clients (the
@@ -160,7 +164,7 @@ def apply_update_attack(cfg, stacked_updates, corrupt_flags,
             f"channel (host-sampled chained blocks) — run device-resident "
             f"or cohort-sampled")
     with jax.named_scope("attack"):
-        scale = update_scale(cfg, corrupt_flags, active)
+        scale = update_scale(cfg, corrupt_flags, active, boost=boost)
 
         def leaf(u):
             s = scale.reshape((-1,) + (1,) * (u.ndim - 1))

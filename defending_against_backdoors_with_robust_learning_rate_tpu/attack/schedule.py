@@ -67,3 +67,22 @@ def active(cfg, rnd):
     if cfg.attack_every > 1:
         on = on & ((rnd - cfg.attack_start) % cfg.attack_every == 0)
     return on
+
+
+def active_traced(start, stop, every, rnd):
+    """`active` with the schedule fields as TRACED int32 values — the
+    multi-tenant pack's gate (fl/tenancy.py), where every tenant carries
+    its own (start, stop, every) triple as [E]-vector knobs and the
+    Python-level `if`s above cannot branch per tenant. Fully-traced
+    equivalents of the same three conditions: a trivial (0, 0, 1)
+    schedule evaluates to always-on, matching the solo paths' gate-free
+    fast path arithmetically."""
+    rnd = jnp.asarray(rnd, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    stop = jnp.asarray(stop, jnp.int32)
+    every = jnp.asarray(every, jnp.int32)
+    on = rnd >= start
+    on = on & ((stop <= 0) | (rnd < stop))
+    # every >= 1 is validated at pack construction; % every is safe
+    on = on & ((rnd - start) % jnp.maximum(every, 1) == 0)
+    return on

@@ -243,6 +243,22 @@ class Config:
     bank_shard_clients: int = 65536  # clients per bank index-shard file
                                     # (IO layout only — bank content is
                                     # provably layout-independent)
+    # --- multi-tenant megabatched sweeps (fl/tenancy.py, ISSUE 13) ---
+    tenants: int = 0                # >0: this config is a TENANT PACK of E
+                                    # independent experiment replicas run
+                                    # as one resident program — the
+                                    # experiment axis folded the way
+                                    # megabatch folded the client axis.
+                                    # Per-tenant scalar knobs (seed,
+                                    # server_lr, robustLR_threshold,
+                                    # attack_boost, schedule gates) enter
+                                    # as traced [E]-vectors; knobs that
+                                    # change shapes stay queue-level.
+                                    # 0 = the untenanted (solo) paths,
+                                    # bit-for-bit the historical programs.
+                                    # Normally set by the experiment queue
+                                    # (service/queue.py --tenants), not by
+                                    # hand.
     # --- continuous-service driver (service/driver.py) ---
     service_rounds: int = 0         # serve(): total rounds to stream; 0 =
                                     # indefinitely (until the stop file
@@ -467,6 +483,12 @@ FIELD_PROVENANCE = {
     "attack_start": "program",     # baked into the traced schedule gate
     "attack_stop": "program",
     "attack_every": "program",
+    "tenants": "program",          # E>0 selects the *_mt tenant-pack
+                                   # program families (fl/tenancy.py):
+                                   # the tenant axis is a traced leading
+                                   # dimension of every carried array, so
+                                   # the tenant count must split the AOT
+                                   # cache (the [E, ...] avals pin it too)
     "rlr_adapt": "runtime",        # service-driver adaptation policy —
                                    # applied by REBUILDING programs with a
                                    # new robustLR_threshold, never read in
@@ -779,6 +801,13 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.bank_shard_clients,
                    help="clients per bank index-shard file (IO layout "
                         "only; content is layout-independent)")
+    p.add_argument("--tenants", type=int, default=d.tenants,
+                   help="multi-tenant pack width E (fl/tenancy.py): >0 "
+                        "runs E independent experiment replicas as one "
+                        "resident *_mt program with per-tenant seeds/"
+                        "thresholds/LRs as traced [E]-vectors; normally "
+                        "driven by the experiment queue "
+                        "(service/queue.py --tenants), 0 = solo paths")
     p.add_argument("--service_rounds", type=int, default=d.service_rounds,
                    help="service mode: total rounds to stream (0 = run "
                         "until <log_dir>/service.stop appears)")

@@ -75,12 +75,15 @@ def guard_empty(agg_tree, mask):
                     agg_tree)
 
 
-def rlr_threshold(cfg, mask):
+def rlr_threshold(cfg, mask, base=None):
     """Mask-aware RLR vote threshold. ``abs`` keeps the paper's absolute
     count (the vote just loses the masked voters); ``scaled`` shrinks the
     threshold with the effective electorate (threshold * n_eff / m) so the
-    required agreement *fraction* is invariant under churn."""
-    thr = float(cfg.robustLR_threshold)
+    required agreement *fraction* is invariant under churn. ``base``
+    overrides the config constant with a traced scalar — the multi-tenant
+    pack's per-tenant threshold knob (fl/tenancy.py); None keeps the solo
+    paths' Python float."""
+    thr = float(cfg.robustLR_threshold) if base is None else base
     if cfg.rlr_threshold_mode == "scaled":
         return thr * count_f32(mask) / mask.shape[0]
     return thr

@@ -60,12 +60,15 @@ def _pallas_applicable(cfg) -> bool:
     # the fused kernel does not take — same fallback as faults/churn.
     # In-jit attack strategies transform the updates BEFORE the server
     # step, which the fused kernel's one-pass read would skip.
+    # tenant packs (fl/tenancy.py) carry per-tenant thresholds/LRs as
+    # traced knobs, which the fused kernel bakes as Python floats
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
             and cfg.noise == 0 and not cfg.diagnostics
             and not cfg.faults_enabled and not cfg.churn_enabled
             and not attack_registry.in_jit(cfg)
             and not compile_cache.is_cohort_mode(cfg)
             and not buffered.is_buffered(cfg)
+            and cfg.tenants == 0
             and cfg.telemetry == "off")
 
 
@@ -198,7 +201,7 @@ def make_block_trainer(model, cfg, normalize):
 
 def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 train_block, cfg, corrupt_flags=None, churn_active=None,
-                rnd=None, astate=None):
+                rnd=None, astate=None, knobs=None):
     """Shared round body: vmapped local training + aggregation + update.
 
     With faults configured (cfg.faults_enabled) the round additionally
@@ -226,7 +229,14 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     tail through the buffered-async fold instead of the immediate
     aggregate+apply; the straggler draw then delays the upload (latency
     draw) instead of truncating epochs, and the return grows a fourth
-    element (the advanced buffer state)."""
+    element (the advanced buffer state).
+
+    `knobs` (fl/tenancy.TenantKnobs of traced scalars — this tenant's
+    slice of the pack's [E]-vectors, arriving through the tenant vmap)
+    overrides the per-experiment scalar constants the solo paths bake in:
+    server_lr, the RLR threshold, the attack boost and the schedule
+    window. None (every solo path) keeps the Python constants — the
+    traced program is bit-for-bit the historical one."""
     m = imgs.shape[0]
     agent_keys = jax.random.split(k_train, m)
     draw = None
@@ -250,9 +260,23 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
         registry as attack_registry)
     if attack_registry.in_jit(cfg):
-        updates = attack_registry.apply_update_attack(
-            cfg, updates, corrupt_flags,
-            attack_registry.schedule_active(cfg, rnd))
+        if knobs is not None:
+            # tenant pack: every tenant carries its own schedule triple
+            # and boost as traced knobs (attack/schedule.active_traced —
+            # the trivial (0, 0, 1) triple evaluates to always-on, so
+            # unscheduled tenants match the solo gate-free fast path)
+            from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+                schedule as attack_schedule)
+            gate = attack_schedule.active_traced(
+                knobs.attack_start, knobs.attack_stop, knobs.attack_every,
+                rnd)
+            updates = attack_registry.apply_update_attack(
+                cfg, updates, corrupt_flags, gate,
+                boost=knobs.attack_boost)
+        else:
+            updates = attack_registry.apply_update_attack(
+                cfg, updates, corrupt_flags,
+                attack_registry.schedule_active(cfg, rnd))
     mask = None
     extras = {}
     if draw is not None:
@@ -313,13 +337,18 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
             float(cfg.robustLR_threshold), cfg.effective_server_lr,
             interpret=jax.default_backend() != "tpu", mode=cfg.aggr)
         return new_params, jnp.mean(losses), {}
+    slr = (cfg.effective_server_lr if knobs is None
+           else knobs.server_lr)
     with jax.named_scope("aggregate_rlr"):
         if cfg.robustLR_threshold > 0:
-            thr = (masking.rlr_threshold(cfg, mask) if mask is not None
-                   else float(cfg.robustLR_threshold))
-            lr = robust_lr(updates, thr, cfg.effective_server_lr, mask=mask)
+            thr_base = None if knobs is None else knobs.rlr_threshold
+            thr = (masking.rlr_threshold(cfg, mask, base=thr_base)
+                   if mask is not None
+                   else (float(cfg.robustLR_threshold)
+                         if knobs is None else knobs.rlr_threshold))
+            lr = robust_lr(updates, thr, slr, mask=mask)
         else:
-            lr = cfg.effective_server_lr
+            lr = slr
         agg = aggregate_updates(updates, sizes, cfg, k_noise, mask=mask)
         if mask is not None:
             # all payloads dropped/rejected -> zero aggregate, no-op round

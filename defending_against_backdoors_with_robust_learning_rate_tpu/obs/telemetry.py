@@ -359,6 +359,26 @@ def compute_sharded_bucket(cfg, updates_local, info, axis_name,
 
 # --- host side -----------------------------------------------------------
 
+def tenant_rows(vals, e: int, allowed=None) -> dict:
+    """One tenant's slice of [E]-stacked telemetry values (host-fetched,
+    the multi-tenant pack fan-out — service/tenancy.py): every tel_*
+    leaf indexed at ``e`` on its leading tenant axis. ``allowed``
+    (optional iterable of tel_* keys — telemetry_keys of the TENANT's
+    own config) filters series the pack computes but this tenant's solo
+    twin would not emit (e.g. tel_flip_frac on an undefended tenant in a
+    pack that builds the RLR vote), so per-tenant streams stay
+    row-compatible with solo runs."""
+    out = {}
+    keep = None if allowed is None else set(allowed)
+    for key in sorted(vals):
+        if not key.startswith(PREFIX):
+            continue
+        if keep is not None and key not in keep:
+            continue
+        out[key] = vals[key][e]
+    return out
+
+
 def host_summary(vals) -> dict:
     """JSON-able snapshot of the telemetry values in `vals`
     (host-fetched): tel_* scalars as floats, tel_margin_hist as a float

@@ -201,7 +201,7 @@ def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
 
 
 def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
-                         mask_full=None):
+                         mask_full=None, knobs=None):
     """aggr='sign' + RLR: ONE sign-sum psum per leaf, read twice — the
     vote takes |s| and the aggregate takes sign(s).
 
@@ -215,14 +215,19 @@ def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
     with server noise + empty-electorate guard applied, mirroring
     _sharded_aggregate's tail; `sign_sums` is the raw per-leaf psum
     result, handed to full telemetry so its vote-margin histogram reads
-    the SAME collective instead of issuing a third copy per leaf."""
-    thr = float(cfg.robustLR_threshold)
+    the SAME collective instead of issuing a third copy per leaf.
+    `knobs` (fl/tenancy.TenantKnobs scalars, inside the tenant vmap)
+    overrides the threshold/server-lr constants per tenant."""
+    thr = (float(cfg.robustLR_threshold) if knobs is None
+           else knobs.rlr_threshold)
     if mask_local is not None:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
             masking)
         updates = masking.zero_masked(updates, mask_local)
-        thr = masking.rlr_threshold(cfg, mask_full)
-    slr = cfg.effective_server_lr
+        thr = masking.rlr_threshold(
+            cfg, mask_full,
+            base=None if knobs is None else knobs.rlr_threshold)
+    slr = cfg.effective_server_lr if knobs is None else knobs.server_lr
     leaves, treedef = jax.tree_util.tree_flatten(updates)
     lr_leaves, agg_leaves, s_leaves = [], [], []
     for u in leaves:
@@ -243,21 +248,27 @@ def _sharded_sign_shared(updates, cfg, noise_key, mask_local=None,
     return lr, agg, sign_sums
 
 
-def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None):
+def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None,
+                       knobs=None):
     """RLR sign-agreement vote as a psum (src/aggregation.py:48-54 semantics,
     vote over exactly the m sampled agents — minus masked-out voters on the
     faults path, where the threshold may also scale with the electorate).
     Returns (lr_tree, abs_sign_sums_tree): the |psum| the vote thresholds
     is also exactly the margin full telemetry histograms, so handing it
     out keeps telemetry's collective count at zero extra psums (the same
-    sharing `_sharded_sign_shared` does for the sign aggregate)."""
-    thr = float(cfg.robustLR_threshold)
+    sharing `_sharded_sign_shared` does for the sign aggregate).
+    `knobs` overrides the threshold/server-lr constants per tenant
+    (fl/tenancy.py)."""
+    thr = (float(cfg.robustLR_threshold) if knobs is None
+           else knobs.rlr_threshold)
     if mask_local is not None:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
             masking)
         updates = masking.zero_masked(updates, mask_local)
-        thr = masking.rlr_threshold(cfg, mask_full)
-    slr = cfg.effective_server_lr
+        thr = masking.rlr_threshold(
+            cfg, mask_full,
+            base=None if knobs is None else knobs.rlr_threshold)
+    slr = cfg.effective_server_lr if knobs is None else knobs.server_lr
     leaves, treedef = jax.tree_util.tree_flatten(updates)
     lr_leaves, s_leaves = [], []
     for u in leaves:
@@ -295,7 +306,7 @@ class _BucketInfo:
 
 
 def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
-                    mask_local=None, mask_full=None):
+                    mask_local=None, mask_full=None, knobs=None):
     """avg/sign [+ RLR] aggregation on the bucketed flat layout
     (parallel/buckets.py): ONE reduce-scatter per bucket of the stacked
     partial sums (weighted sum and/or sign sum ride the SAME collective),
@@ -318,14 +329,17 @@ def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
     ax = AGENTS_AXIS
     masked = mask_local is not None
     rlr = cfg.robustLR_threshold > 0
-    thr = float(cfg.robustLR_threshold)
+    thr = (float(cfg.robustLR_threshold) if knobs is None
+           else knobs.rlr_threshold)
     if masked:
         from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
             masking)
         updates = masking.zero_masked(updates, mask_local)
         if rlr:
-            thr = masking.rlr_threshold(cfg, mask_full)
-    slr = cfg.effective_server_lr
+            thr = masking.rlr_threshold(
+                cfg, mask_full,
+                base=None if knobs is None else knobs.rlr_threshold)
+    slr = cfg.effective_server_lr if knobs is None else knobs.server_lr
     layout = buckets.layout_for_stacked(updates, d)
     flat = buckets.flatten_stacked(layout, updates)       # [mb, padded]
 
@@ -541,7 +555,7 @@ def _sharded_pallas_apply(params, updates, sizes, cfg):
 
 
 def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
-                        take_active=None):
+                        take_active=None, mt=False):
     """The shard_mapped round body shared by the per-round and chained fns.
 
     With faults — or full telemetry — configured the body takes a trailing
@@ -566,7 +580,17 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     on the leaf AND bucketed layouts (pinned by the *_atk_* contract
     specs). A *scheduled* attack adds one more trailing replicated input:
     the scalar schedule gate, computed OUTSIDE shard_map from the round
-    index (like the churn mask — the body never needs the index itself)."""
+    index (like the churn mask — the body never needs the index itself).
+
+    ``mt`` (ISSUE 13, fl/tenancy.py) builds the tenant-pack variant: the
+    body is `jax.vmap`ped over a leading [E] tenant axis INSIDE the
+    shard_map, a trailing replicated TenantKnobs input carries the
+    per-tenant scalar knobs, and the in-jit attack gate input is forced
+    on whenever the strategy is in-jit (every tenant carries its own
+    schedule window). Collectives under vmap batch over the tenant axis
+    — one psum of an [E, ...] payload, not E psums — so the leaf AND
+    bucket collective plans are unchanged by construction (pinned by the
+    *_mt CheckSpecs at 1/8/16-way)."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
         registry as attack_registry)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
@@ -574,7 +598,14 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     faults_on = cfg.faults_enabled
     churn_on = cfg.churn_enabled if take_active is None else take_active
     atk_on = attack_registry.in_jit(cfg)
-    atk_sched = attack_registry.needs_round(cfg)
+    # tenant packs gate every in-jit attack per tenant (the trivial
+    # schedule's traced gate is always-on); solo bodies only take the
+    # gate input when a schedule actually needs the round index
+    atk_sched = (atk_on if mt else attack_registry.needs_round(cfg))
+    if mt and buffered.is_buffered(cfg):
+        raise ValueError(
+            "--agg_mode buffered is not tenant-packed yet (the carried "
+            "buffer state is per-run); run buffered cells solo")
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     if faults_on:
@@ -619,6 +650,9 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
         # carry — both replicated; the fold is elementwise post-psum
         # (fl/buffered.py), so the collective plan is the sync family's.
         params, astate = carry if is_async else (carry, None)
+        # tenant-pack mode: the LAST trailing input is the per-tenant
+        # TenantKnobs (scalars here — the tenant vmap wraps this body)
+        knobs = rest[-1] if mt else None
         idx = 0
         corrupt_full = churn_full = atk_active = None
         if take_flags:
@@ -650,7 +684,8 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             # each device scales ITS corrupt rows — elementwise on the
             # local block, replicated inputs, zero collectives
             updates = attack_registry.apply_update_attack(
-                cfg, updates, local(corrupt_full), atk_active)
+                cfg, updates, local(corrupt_full), atk_active,
+                boost=None if knobs is None else knobs.attack_boost)
         if faults_on:
             from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
                 masking)
@@ -745,20 +780,23 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                 lr = agg = None
                 new_params, bucket_info = _bucketed_apply(
                     params, updates, szs, cfg, noise_key, d,
-                    mask_local, mask_full)
+                    mask_local, mask_full, knobs=knobs)
             elif cfg.robustLR_threshold > 0 and cfg.aggr == "sign":
                 # vote + aggregate share one sign-sum psum per leaf (the
                 # CSE XLA was measured not to do — see _sharded_sign_shared)
                 lr, agg, sign_sums = _sharded_sign_shared(
-                    updates, cfg, noise_key, mask_local, mask_full)
+                    updates, cfg, noise_key, mask_local, mask_full,
+                    knobs=knobs)
                 new_params = apply_aggregate(params, lr, agg)
             else:
                 if cfg.robustLR_threshold > 0:
                     lr, sign_sums = _sharded_robust_lr(updates, cfg,
                                                        mask_local,
-                                                       mask_full)
+                                                       mask_full,
+                                                       knobs=knobs)
                 else:
-                    lr = cfg.effective_server_lr
+                    lr = (cfg.effective_server_lr if knobs is None
+                          else knobs.server_lr)
                 agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
                                          mask_local, mask_full)
                 new_params = apply_aggregate(params, lr, agg)
@@ -823,6 +861,22 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
         if cfg.robustLR_threshold > 0:
             extras_specs["lr_flat"] = P()
 
+    if mt:
+        # tenant axis INSIDE the shard: every input grows a leading [E]
+        # (the data stacks shard the AGENTS axis at position 1), the
+        # knobs ride as one more replicated input, and jax.vmap batches
+        # the body — collectives batch over the tenant axis instead of
+        # multiplying, so the pinned plan is unchanged by construction
+        agents = P(None, AGENTS_AXIS)
+        in_specs = (P(), agents, agents, agents, agents, P()) \
+            + ((P(),) if take_flags else ()) \
+            + ((P(),) if churn_on else ()) \
+            + ((P(),) if atk_sched else ()) + (P(),)
+        return shard_map(
+            jax.vmap(shard_body), mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P(), extras_specs),
+            check_vma=False)
     in_specs = (P(), P(AGENTS_AXIS), P(AGENTS_AXIS), P(AGENTS_AXIS),
                 P(AGENTS_AXIS), P()) + ((P(),) if take_flags else ()) \
         + ((P(),) if churn_on else ()) + ((P(),) if atk_sched else ())
@@ -903,6 +957,71 @@ def make_sharded_round_fn(cfg, model, normalize, mesh,
                      family=("round_sharded_diag" if cfg.diagnostics
                              else "round_sharded"
                              + compile_cache.family_suffix(cfg)))
+
+
+def make_sharded_round_fn_mt(cfg, model, normalize, mesh,
+                             images, labels, sizes):
+    """Tenant-pack sharded round fn (ISSUE 13, fl/tenancy.py):
+    round(params_E, keys_E, rnd, knobs) -> (params_E, info) with every
+    carried array [E]-stacked and the tenant axis folded INSIDE the
+    shard (each device trains its m/d-agent block for all E tenants; the
+    per-leaf psums / bucketed reduce-scatters batch over the tenant axis
+    instead of multiplying — the *_mt CheckSpecs pin the unchanged plan
+    at 1/8/16-way). Per-tenant sampling, corrupt flags, churn masks and
+    schedule gates are computed OUTSIDE shard_map from the per-tenant
+    keys/knobs and enter replicated, the solo body's exact discipline."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
+        registry as attack_registry, schedule as attack_schedule)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        host_takes_flags)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    sharded = _build_sharded_body(cfg, model, normalize, mesh, mt=True)
+    K, m = cfg.num_agents, cfg.agents_per_round
+    want_flags = host_takes_flags(cfg)
+    atk_gated = attack_registry.in_jit(cfg)
+
+    def step(params_E, keys_E, rnd, knobs, images, labels, sizes):
+        def sample(key):
+            k_sample, k_train, k_noise = jax.random.split(key, 3)
+            sampled = jax.random.permutation(k_sample, K)[:m]
+            return sampled, jax.random.split(k_train, m), k_noise
+
+        with jax.named_scope("sample_gather"):
+            sampled_E, agent_keys_E, k_noise_E = jax.vmap(sample)(keys_E)
+            imgs = jnp.take(images, sampled_E, axis=0)   # [E, m, ...]
+            lbls = jnp.take(labels, sampled_E, axis=0)
+            szs = jnp.take(sizes, sampled_E, axis=0)
+        extra = ()
+        if want_flags:
+            extra += (sampled_E < cfg.num_corrupt,)
+        if cfg.churn_enabled:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+                churn as churn_mod)
+            with jax.named_scope("churn_mask"):
+                extra += (jax.vmap(
+                    lambda s: churn_mod.active_slots(cfg, s, rnd))(
+                        sampled_E),)
+        if atk_gated:
+            # per-tenant schedule gates from the traced knob triples —
+            # replicated [E] input, zero collectives (the solo gate idiom)
+            extra += (attack_schedule.active_traced(
+                knobs.attack_start, knobs.attack_stop,
+                knobs.attack_every, rnd),)
+        new_params, train_loss, extras = sharded(
+            params_E, imgs, lbls, szs, agent_keys_E, k_noise_E,
+            *extra, knobs)
+        return new_params, {"train_loss": train_loss,
+                            "sampled": sampled_E, **extras}
+
+    jitted = jax.jit(step)
+
+    def bound(params_E, keys_E, rnd, knobs):
+        return jitted(params_E, keys_E, rnd, knobs, images, labels, sizes)
+
+    bound.jitted, bound.data = jitted, (images, labels, sizes)
+    bound.family = "round_sharded" + compile_cache.family_suffix(cfg)
+    return bound
 
 
 def make_sharded_host_step(cfg, model, normalize, mesh, take_flags=None):

@@ -19,4 +19,9 @@ supervised FL service.
     queue.py       experiment queue: scenario cells back-to-back in one
                    process against one AOT bank (FL_PyTorch's
                    simulator-as-service gap, arXiv:2202.03099)
+    tenancy.py     multi-tenant tenant packs (ISSUE 13): up to E
+                   shape-compatible queue cells as ONE resident *_mt
+                   program (fl/tenancy.py), grouped by the
+                   compile-cache fingerprint's field algebra, metrics
+                   fanned back out per tenant through the MetricsDrain
 """

@@ -17,16 +17,25 @@ Queue file (JSON): either a bare list of override dicts, or
      {"aggr": "sign", "server_lr": 1.0}]
 
 Each finished cell appends one flushed row to
-``<log_dir>/queue_results.jsonl`` (summary + the service counters when the
-cell ran in service mode), so a mid-queue kill keeps completed rows — the
-same crash discipline as the rest of the service subsystem. A cell whose
-run *fails* is recorded with its error and the queue moves on: one
-poisoned cell must not abort the matrix.
+``<log_dir>/queue_results.jsonl`` (summary + the resolved ``run_name`` so
+rows join to run dirs + the service counters when the cell ran in service
+mode), so a mid-queue kill keeps completed rows — the same crash
+discipline as the rest of the service subsystem. A cell whose run *fails*
+is recorded with its error and the queue moves on: one poisoned cell must
+not abort the matrix. The FINAL row is a queue-level throughput summary
+(``queue_summary``: cells/hour, aggregate wall, compile-vs-steady split).
+
+``--tenants E`` (ISSUE 13, service/tenancy.py) folds the EXPERIMENT axis:
+shape-compatible cells (grouped by the compile-cache fingerprint's own
+field algebra, utils/compile_cache.tenant_pack_key) run up to E at a time
+as ONE resident ``*_mt`` program with per-tenant seeds/thresholds/LRs as
+traced [E]-vectors; incompatible cells fall back to this serial path with
+a printed note.
 
 Entry point::
 
     python -m defending_against_backdoors_with_robust_learning_rate_tpu.service.queue \
-        --queue cells.json --data synthetic --rounds 8 --snap 4
+        --queue cells.json --data synthetic --rounds 8 --snap 4 [--tenants 8]
 """
 
 from __future__ import annotations
@@ -77,59 +86,207 @@ def _apply_overrides(base: Config, overrides: Dict[str, Any]) -> Config:
     return base.replace(**overrides)
 
 
+def _cell_cfg(base_cfg: Config, cell: Dict[str, Any]) -> Config:
+    cfg = _apply_overrides(base_cfg, cell["overrides"])
+    if cfg.checkpoint_dir and "checkpoint_dir" not in cell["overrides"]:
+        # a shared checkpoint dir would make cell N resume cell
+        # N-1's journaled state (serve always resumes; same-shape
+        # one-shot cells cross-restore too) — isolate per cell
+        cfg = cfg.replace(checkpoint_dir=os.path.join(
+            cfg.checkpoint_dir, cell["name"]))
+    return cfg
+
+
+def _new_row(base_cfg: Config, cell: Dict[str, Any]) -> Dict[str, Any]:
+    row: Dict[str, Any] = {"cell": cell["name"],
+                           "overrides": cell["overrides"],
+                           "started": time.time()}
+    try:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+            run_name)
+        # the resolved run-dir name rides every row so rows join to run
+        # dirs (metrics.jsonl / trace.json) without re-deriving the name
+        row["run_name"] = run_name(_cell_cfg(base_cfg, cell))
+    except Exception:
+        pass   # a broken cell still gets its (failed) row below
+    if "meta" in cell:
+        # caller-computed cell annotations (e.g. the scenario sweep's
+        # simulated-clock cost) ride the row verbatim
+        row["meta"] = cell["meta"]
+    return row
+
+
+def _run_serial_cell(base_cfg: Config, cell: Dict[str, Any],
+                     service_mode: bool) -> Dict[str, Any]:
+    """One cell through the historical serial path (train.run or the
+    supervised service driver); returns its finished row."""
+    row = _new_row(base_cfg, cell)
+    # unknown Config fields are a queue-file AUTHORING error and raise
+    # out of the queue (the historical contract, test-pinned) — only a
+    # cell's RUN failure is recorded-and-skipped
+    cfg = _cell_cfg(base_cfg, cell)
+    t0 = time.perf_counter()
+    try:
+        if service_mode:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.service.driver import (
+                serve)
+            summary = serve(cfg)
+            row["service"] = summary.get("service")
+        else:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+                run)
+            summary = run(cfg)
+        row["summary"] = {k: summary[k] for k in SUMMARY_KEYS
+                          if k in summary}
+        row["ok"] = True
+    except Exception as e:  # one poisoned cell != a dead matrix
+        row["ok"] = False
+        row["error"] = f"{type(e).__name__}: {e}"
+        print(f"[queue] cell {cell['name']!r} FAILED: "
+              f"{row['error']} — continuing with the next cell")
+    row["wall_s"] = round(time.perf_counter() - t0, 3)
+    return row
+
+
+def _run_pack_cells(base_cfg: Config, pack: List[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """One tenant pack (service/tenancy.py): E cells as one resident
+    *_mt program, one finished row per cell. A pack failure is recorded
+    on every member cell and the queue moves on (the record-and-skip
+    contract, pack-shaped)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+        tenancy)
+    rows = [_new_row(base_cfg, cell) for cell in pack]
+    t0 = time.perf_counter()
+    try:
+        cfgs = [_cell_cfg(base_cfg, cell) for cell in pack]
+        summaries, pack_info = tenancy.run_pack(
+            cfgs, names=[c["name"] for c in pack])
+    except tenancy.PackIneligible as e:
+        # a refusal only run_pack could see (e.g. host-sampled 'auto'
+        # resolving ON against the loaded dataset's bytes) — before any
+        # program build; the members get their solo runs, not a failure
+        print(f"[tenancy] pack {[c['name'] for c in pack]} -> serial "
+              f"({e})")
+        return [_run_serial_cell(base_cfg, cell, False) for cell in pack]
+    except Exception as e:
+        wall = round(time.perf_counter() - t0, 3)
+        for row in rows:
+            row["ok"] = False
+            row["error"] = f"{type(e).__name__}: {e}"
+            row["wall_s"] = round(wall / len(pack), 3)
+        print(f"[queue] tenant pack "
+              f"{[c['name'] for c in pack]} FAILED: "
+              f"{rows[0]['error']} — continuing with the next cells")
+    else:
+        wall = round(time.perf_counter() - t0, 3)
+        for slot, (row, summary) in enumerate(zip(rows, summaries,
+                                                  strict=True)):
+            row["summary"] = {k: summary[k] for k in SUMMARY_KEYS
+                              if k in summary}
+            row["ok"] = True
+            # the pack's wall clock is SHARED: per-cell cost is wall/E,
+            # which is exactly what cells/hour should bill
+            row["wall_s"] = round(wall / len(pack), 3)
+            row["tenancy"] = {"slot": slot, **pack_info}
+    return rows
+
+
+def _queue_summary_row(rows: List[Dict[str, Any]],
+                       wall_s: float) -> Dict[str, Any]:
+    """The queue-level throughput summary appended as the FINAL
+    queue_results.jsonl row: cells/hour, the aggregate wall, and the
+    compile-vs-steady split (per-cell steady seconds estimated from each
+    summary's rounds/steady-rate pair; the remainder is compile+warmup)."""
+    ok = [r for r in rows if r.get("ok")]
+    steady_s = warmup_s = 0.0
+    for r in ok:
+        summ = r.get("summary", {})
+        srps, rnds = summ.get("steady_rounds_per_sec"), summ.get("round")
+        cell_wall = r.get("wall_s", 0.0)
+        ten = r.get("tenancy")
+        if ten:
+            # packed cells: run_pack measured the pack's true
+            # compile/AOT seconds — bill each tenant its 1/E share
+            # (wall_s is already wall/E; the summary's steady rate is
+            # pack-level and would overcount E-fold)
+            w = min(cell_wall,
+                    ten.get("compile_s", 0.0) / max(ten["tenants"], 1))
+            warmup_s += w
+            steady_s += max(0.0, cell_wall - w)
+        elif srps and rnds:
+            s = min(cell_wall, rnds / srps)
+            steady_s += s
+            warmup_s += max(0.0, cell_wall - s)
+        else:
+            warmup_s += cell_wall
+    packed = sum(1 for r in ok if "tenancy" in r)
+    return {
+        "queue_summary": True,
+        "cells": len(rows), "ok": len(ok),
+        "packed_cells": packed, "serial_cells": len(ok) - packed,
+        "wall_s": round(wall_s, 3),
+        "cells_per_hour": round(3600.0 * len(ok) / max(wall_s, 1e-9), 2),
+        "steady_s": round(steady_s, 3),
+        "compile_warmup_s": round(warmup_s, 3),
+    }
+
+
 def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
               results_path: Optional[str] = None,
-              service_mode: bool = False) -> List[Dict[str, Any]]:
+              service_mode: bool = False,
+              tenants: int = 0) -> List[Dict[str, Any]]:
     """Run every cell against one AOT bank; returns (and streams) one
-    result row per cell. ``service_mode`` routes cells through
-    service.driver.serve (supervised, journaled) instead of train.run."""
+    result row per cell, plus a final queue-level throughput summary
+    row. ``service_mode`` routes cells through service.driver.serve
+    (supervised, journaled) instead of train.run. ``tenants`` E >= 2
+    groups shape-compatible cells into tenant packs of up to E run as
+    ONE resident *_mt program (service/tenancy.py); incompatible cells
+    fall back to the serial path with a printed note."""
     results_path = results_path or os.path.join(base_cfg.log_dir,
                                                 "queue_results.jsonl")
     os.makedirs(os.path.dirname(results_path) or ".", exist_ok=True)
+    if tenants >= 2 and service_mode:
+        print("[queue] --tenants ignored in --service mode (supervised "
+              "cells are per-run journaled; packing is one-shot)")
+        tenants = 0
+    if tenants >= 2:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+            tenancy)
+        items = tenancy.plan_packs(base_cfg, cells, tenants,
+                                   _apply_overrides)
+        n_pack = sum(1 for kind, _ in items if kind == "pack")
+        print(f"[queue] tenancy E={tenants}: {n_pack} packs + "
+              f"{len(items) - n_pack} serial cells over {len(cells)} "
+              f"cells")
+    else:
+        items = [("serial", [cell]) for cell in cells]
     rows: List[Dict[str, Any]] = []
+    t_queue = time.perf_counter()
     with open(results_path, "a", encoding="utf-8") as out:
-        for i, cell in enumerate(cells):
-            cfg = _apply_overrides(base_cfg, cell["overrides"])
-            if cfg.checkpoint_dir and "checkpoint_dir" not in cell["overrides"]:
-                # a shared checkpoint dir would make cell N resume cell
-                # N-1's journaled state (serve always resumes; same-shape
-                # one-shot cells cross-restore too) — isolate per cell
-                cfg = cfg.replace(checkpoint_dir=os.path.join(
-                    cfg.checkpoint_dir, cell["name"]))
-            print(f"[queue] cell {i + 1}/{len(cells)} {cell['name']!r}: "
-                  f"{cell['overrides']}")
-            row: Dict[str, Any] = {"cell": cell["name"],
-                                   "overrides": cell["overrides"],
-                                   "started": time.time()}
-            if "meta" in cell:
-                # caller-computed cell annotations (e.g. the scenario
-                # sweep's simulated-clock cost) ride the row verbatim
-                row["meta"] = cell["meta"]
-            t0 = time.perf_counter()
-            try:
-                if service_mode:
-                    from defending_against_backdoors_with_robust_learning_rate_tpu.service.driver import (
-                        serve)
-                    summary = serve(cfg)
-                    row["service"] = summary.get("service")
-                else:
-                    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
-                        run)
-                    summary = run(cfg)
-                row["summary"] = {k: summary[k] for k in SUMMARY_KEYS
-                                  if k in summary}
-                row["ok"] = True
-            except Exception as e:  # one poisoned cell != a dead matrix
-                row["ok"] = False
-                row["error"] = f"{type(e).__name__}: {e}"
-                print(f"[queue] cell {cell['name']!r} FAILED: "
-                      f"{row['error']} — continuing with the next cell")
-            row["wall_s"] = round(time.perf_counter() - t0, 3)
-            out.write(json.dumps(row) + "\n")
-            out.flush()   # a mid-queue kill keeps completed rows
-            rows.append(row)
+        for kind, group in items:
+            if kind == "pack":
+                print(f"[queue] tenant pack x{len(group)}: "
+                      f"{[c['name'] for c in group]}")
+                new_rows = _run_pack_cells(base_cfg, group)
+            else:
+                cell = group[0]
+                print(f"[queue] cell {len(rows) + 1}/{len(cells)} "
+                      f"{cell['name']!r}: {cell['overrides']}")
+                new_rows = [_run_serial_cell(base_cfg, cell,
+                                             service_mode)]
+            for row in new_rows:
+                out.write(json.dumps(row) + "\n")
+                out.flush()   # a mid-queue kill keeps completed rows
+                rows.append(row)
+        summary_row = _queue_summary_row(
+            rows, time.perf_counter() - t_queue)
+        out.write(json.dumps(summary_row) + "\n")
+        out.flush()
     done = sum(r["ok"] for r in rows)
-    print(f"[queue] {done}/{len(rows)} cells completed -> {results_path}")
+    print(f"[queue] {done}/{len(rows)} cells completed "
+          f"({summary_row['cells_per_hour']} cells/hour) "
+          f"-> {results_path}")
     return rows
 
 
@@ -144,6 +301,11 @@ def main(argv=None) -> int:
                          "instead of the one-shot trainer")
     qp.add_argument("--results", default="",
                     help="queue_results.jsonl path (default: <log_dir>/)")
+    qp.add_argument("--tenants", type=int, default=0,
+                    help="tenant-pack width E (service/tenancy.py): >=2 "
+                         "runs up to E shape-compatible cells as ONE "
+                         "resident *_mt program; incompatible cells fall "
+                         "back to the serial path")
     qargs, rest = qp.parse_known_args(argv)
     base_cfg = args_parser(rest)
     if base_cfg.platform:
@@ -151,7 +313,7 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", base_cfg.platform)
     cells = load_cells(qargs.queue)
     rows = run_queue(base_cfg, cells, results_path=qargs.results or None,
-                     service_mode=qargs.service)
+                     service_mode=qargs.service, tenants=qargs.tenants)
     return 0 if all(r["ok"] for r in rows) else 1
 
 

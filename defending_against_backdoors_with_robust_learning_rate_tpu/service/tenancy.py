@@ -1,0 +1,426 @@
+"""Tenant-pack execution for the experiment queue (ISSUE 13).
+
+`service/queue.py` runs scenario cells back-to-back; this module runs up
+to E shape-compatible cells AT ONCE as one resident `*_mt` program
+(fl/tenancy.py): per-tenant params/metrics carried as a stacked [E, ...]
+pytree, per-tenant scalar knobs (seed, server LR, RLR threshold, attack
+boost/schedule) as traced [E]-vectors, cohorts sampled/trained/
+fault-injected/aggregated together, and every metrics boundary fanned
+back out per tenant through ONE MetricsDrain into each tenant's own run
+dir (the same run_name a solo run of that cell would use, so rows join).
+
+Two layers:
+
+- `plan_packs` — group a queue's cells into shape-compatible tenant
+  packs using the compile-cache fingerprint's own field algebra
+  (utils/compile_cache.tenant_pack_key — never an ad-hoc key list), with
+  ineligible or shape-incompatible cells falling back to the serial path
+  (a printed note per fallback, never a crash);
+- `run_pack` — the pack engine: dataset/model/programs built ONCE, AOT
+  bank adoption for the `*_mt` families, the chained dispatch loop, the
+  tenant-stacked eval pair, and the per-tenant metrics fan-out.
+
+Exactness: per-tenant results are parity-pinned against solo runs
+(tests/test_tenancy.py — ulp-close floats, bitwise sign-rule params
+where the megabatch precedent pins it; dataset content comes from the
+pack's FIRST cell, which only matters for the seed-keyed synthetic
+fallback). Checkpointing/heartbeat/spans are per-run facilities the pack
+deliberately skips — queue cells are one-shot; run such cells solo.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+    tenancy as ftenancy)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+    FAULT_INFO_KEYS)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    attribution as obs_attribution, telemetry as obs_telemetry)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+    compile_cache)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
+    all_finite_device, finite_warn)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+    MetricsDrain, MetricsWriter, run_name)
+
+
+class PackIneligible(ValueError):
+    """A pack refusal discovered only at run_pack time, BEFORE any
+    program build (e.g. the resolved host-sampled mode needs the
+    dataset's byte size, which plan_packs never loads) — the queue
+    catches it and routes the member cells to the serial path instead
+    of recording a pack failure."""
+
+
+def serial_reason(cfg) -> str:
+    """Why a cell routes to the serial path instead of a tenant pack
+    ('' = packable): the program-level refusals
+    (fl/tenancy.ineligible_reason) plus the driver/runtime knobs that
+    module deliberately does not read (it is in the fingerprint audit's
+    program-read scope)."""
+    reason = ftenancy.ineligible_reason(cfg)
+    if reason:
+        return reason
+    if cfg.host_sampled == "on":
+        return "host-sampled mode gathers shards per run; runs solo"
+    if cfg.mesh != 1:
+        return ("the tenant-pack ENGINE is single-device for now (the "
+                "sharded *_mt family exists for the static contracts); "
+                "runs solo")
+    return ""
+
+
+def plan_packs(base_cfg, cells: List[Dict[str, Any]], tenants: int,
+               apply_overrides) -> List[Tuple[str, List[Dict[str, Any]]]]:
+    """Group queue cells into ("pack", [cells...]) / ("serial", [cell])
+    work items, preserving first-appearance order of each shape class.
+
+    Cells are pack-eligible when fl/tenancy.ineligible_reason is empty
+    AND their `tenant_pack_key` (the fingerprint-derived shape/program
+    class) matches; groups chunk into packs of at most `tenants`, and a
+    leftover singleton (or any incompatible cell) runs serial with a
+    printed note. `apply_overrides(base_cfg, overrides)` is the queue's
+    own cell->Config resolution, passed in so the two can never drift."""
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    order: List[str] = []
+    items: List[Tuple[str, List[Dict[str, Any]]]] = []
+    for cell in cells:
+        try:
+            cfg = apply_overrides(base_cfg, cell["overrides"])
+            reason = serial_reason(cfg)
+            key = None if reason else compile_cache.tenant_pack_key(cfg)
+        except Exception as e:  # a broken cell still gets its queue row
+            reason, key = f"{type(e).__name__}: {e}", None
+        if key is None:
+            print(f"[tenancy] cell {cell['name']!r} -> serial "
+                  f"({reason})")
+            items.append(("serial", [cell]))
+            continue
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(cell)
+    for key in order:
+        group = groups[key]
+        for i in range(0, len(group), tenants):
+            pack = group[i:i + tenants]
+            if len(pack) < 2:
+                print(f"[tenancy] cell {pack[0]['name']!r} -> serial "
+                      f"(no shape-compatible partner in this queue)")
+                items.append(("serial", pack))
+            else:
+                items.append(("pack", pack))
+    # keep queue-row order stable: sort items by their first cell's
+    # position in the original list
+    pos = {id(c): i for i, c in enumerate(cells)}
+    items.sort(key=lambda it: pos[id(it[1][0])])
+    return items
+
+
+def _adopt(bank, cfg, family, jit_obj, example_args):
+    """AOT-adopt one tenant family (the train.py _adopt_aot discipline:
+    any failure falls back to the plain jit, which still warm-starts
+    through the persistent XLA cache). Returns (fn_or_None, seconds)."""
+    if bank is None:
+        return None, 0.0
+    try:
+        compiled, hit, secs, _ = bank.get_or_compile(
+            family, cfg, jit_obj, example_args)
+    except Exception as e:
+        print(f"[aot] {family}: falling back to jit "
+              f"({type(e).__name__}: {e})")
+        return None, 0.0
+    print(f"[aot] {family}: "
+          + ("loaded from cache" if hit else "compiled+banked")
+          + f" in {secs:.1f}s")
+    return compiled, secs
+
+
+def run_pack(cfgs, names: Optional[List[str]] = None
+             ) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """Run E shape-compatible cell configs as ONE tenant pack.
+
+    Returns (per-tenant summary dicts in cell order, pack_info) where
+    each summary matches the solo run-summary keys the queue consumes
+    (service/queue.SUMMARY_KEYS) and pack_info carries the pack-level
+    timing split (compile/AOT-acquisition vs steady seconds)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+        get_federated_data)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.common import (
+        make_normalizer)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.evaluate import (
+        pad_eval_set)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
+        get_model, init_params, param_count)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        apply_rng_impl, dispatch_schedule)
+
+    E = len(cfgs)
+    if names is None:
+        names = [f"tenant{e}" for e in range(E)]
+    keys = {compile_cache.tenant_pack_key(c) for c in cfgs}
+    if len(keys) != 1:
+        raise ValueError(
+            f"tenant pack mixes {len(keys)} shape/program classes — the "
+            f"queue grouping (plan_packs) must only hand over cells with "
+            f"one tenant_pack_key")
+    rep = ftenancy.canonical_rep(cfgs[0].replace(tenants=E), cells=cfgs)
+    ftenancy.check(rep)
+    reason = serial_reason(cfgs[0])
+    if reason:
+        raise ValueError(f"tenant pack: {reason}")
+    # cells must agree on rounds/snap (pack-key pinned) — the pack
+    # advances every tenant in lockstep on one dispatch schedule
+    rounds, snap = rep.rounds, rep.snap
+    print(f"[tenancy] pack of {E} tenants x {rounds} rounds "
+          f"({', '.join(names)})")
+    apply_rng_impl(rep.rng_impl)
+    bank = compile_cache.setup(rep)
+    t0 = time.perf_counter()
+
+    # dataset content comes from the pack's FIRST cell (seed-free for
+    # disk-backed data; the synthetic fallback draws from its seed —
+    # documented exactness semantics, README "Multi-tenant sweeps")
+    fed = get_federated_data(cfgs[0])
+    if compile_cache.is_host_mode(rep, fed):
+        # host_sampled='auto' resolves against the loaded data's byte
+        # size — the solo driver would route these cells through the
+        # host-sampled families, but the pack binds the full train
+        # stacks as device-resident jit arguments
+        raise PackIneligible(
+            f"host-sampled mode resolves ON for this dataset "
+            f"({fed.train.images.nbytes / 1e9:.2f} GB train stack "
+            f"exceeds the device-resident budget); running cells solo")
+    model = get_model(rep.data, rep.model_arch, rep.dtype, remat=rep.remat,
+                     remat_policy=rep.remat_policy)
+    norm = make_normalizer(fed.mean, fed.std, fed.raw_is_normalized)
+    arrays = (jnp.asarray(fed.train.images), jnp.asarray(fed.train.labels),
+              jnp.asarray(fed.train.sizes))
+    image_shape = fed.train.images.shape[2:]
+    # per-tenant init from each tenant's OWN seed — bitwise the solo init
+    params_E = ftenancy.stack_params([
+        init_params(model, image_shape, jax.random.PRNGKey(c.seed))
+        for c in cfgs])
+    n_params = param_count(ftenancy.tenant_slice(params_E, 0))
+    base_keys_E = jnp.stack([jax.random.PRNGKey(c.seed) for c in cfgs])
+    knobs = jax.tree_util.tree_map(jnp.asarray,
+                                   ftenancy.knob_vectors(cfgs))
+
+    chain_n = compile_cache.chain_budget(rep)
+    round_fn = ftenancy.make_tenant_round_fn(rep, model, norm, *arrays)
+    chained_fn = (ftenancy.make_tenant_chained_fn(rep, model, norm,
+                                                  *arrays)
+                  if chain_n > 1 else None)
+    eval_fn = ftenancy.make_tenant_eval_fn(model, norm, rep.n_classes)
+    val = tuple(map(jnp.asarray, pad_eval_set(
+        fed.val_images, fed.val_labels, rep.eval_bs)))
+    pval = tuple(map(jnp.asarray, pad_eval_set(
+        fed.pval_images, fed.pval_labels, rep.eval_bs)))
+
+    # --- AOT adoption of the *_mt families (warm packs skip XLA) ---
+    compile_s = 0.0
+    ab = compile_cache.abstractify
+    pE_aval, kE_aval = ab(params_E), ab(base_keys_E)
+    knob_aval = ab(knobs)
+    data_avals = ab(arrays)
+    rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
+    fn, secs = _adopt(bank, rep, round_fn.family, round_fn.jitted,
+                      (pE_aval, kE_aval, rnd_aval, knob_aval) + data_avals)
+    compile_s += secs
+    if fn is not None:
+        data = round_fn.data
+
+        def round_fn(pE, kE, rnd, kn, _fn=fn, _data=data):  # noqa: E731
+            return _fn(pE, kE, rnd, kn, *_data)
+    if chained_fn is not None:
+        ids_aval = jax.ShapeDtypeStruct((chain_n,), jnp.int32)
+        fn, secs = _adopt(bank, rep, chained_fn.family, chained_fn.jitted,
+                          (pE_aval, kE_aval, ids_aval, knob_aval)
+                          + data_avals)
+        compile_s += secs
+        if fn is not None:
+            data = chained_fn.data
+
+            def chained_fn(pE, kE, ids, kn, _fn=fn, _data=data):
+                return _fn(pE, kE, ids, kn, *_data)
+    eval_val_fn = eval_pval_fn = eval_fn
+    fn, secs = _adopt(bank, rep, "eval_val_mt", eval_fn,
+                      (pE_aval,) + ab(val))
+    compile_s += secs
+    if fn is not None:
+        eval_val_fn = fn
+    fn, secs = _adopt(bank, rep, "eval_poison_mt", eval_fn,
+                      (pE_aval,) + ab(pval))
+    compile_s += secs
+    if fn is not None:
+        eval_pval_fn = fn
+
+    # --- per-tenant metrics plumbing: one writer per cell's run dir ---
+    writers = [MetricsWriter(c.log_dir, run_name(c), c.tensorboard)
+               for c in cfgs]
+    drain = (MetricsDrain() if rep.async_metrics else None)
+    # per-tenant tel_* filter: series this tenant's SOLO twin would emit
+    tel_allowed = [obs_telemetry.telemetry_keys(c) for c in cfgs]
+    state = {"cum_poison": [0.0] * E, "summaries": [{} for _ in range(E)],
+             "t_steady": None, "r_steady": 0,
+             "t_steady_end": None, "r_steady_end": 0}
+    fold = jax.jit(jax.vmap(jax.random.fold_in, in_axes=(0, None)))
+
+    def emit(vals, ernd, rounds_done_now, elapsed):
+        """One eval boundary's per-tenant fan-out — runs on the drain
+        thread (async) or inline (sync); mirrors the solo
+        train._emit_eval_body row order so tenant streams byte-compare
+        to solo runs modulo wall-clock rows."""
+        finite_warn(vals["finite"], where=f"pack round {ernd}")
+        now = time.perf_counter()
+        for e, (writer, cfg) in enumerate(zip(writers, cfgs,
+                                              strict=True)):
+            val_loss = float(vals["val_loss"][e])
+            val_acc = float(vals["val_acc"][e])
+            poison_loss = float(vals["poison_loss"][e])
+            poison_acc = float(vals["poison_acc"][e])
+            state["cum_poison"][e] += poison_acc
+            writer.scalar("Validation/Loss", val_loss, ernd)
+            writer.scalar("Validation/Accuracy", val_acc, ernd)
+            writer.scalar("Poison/Base_Class_Accuracy",
+                          float(vals["base_acc"][e]), ernd)
+            writer.scalar("Poison/Poison_Accuracy", poison_acc, ernd)
+            writer.scalar("Poison/Poison_Loss", poison_loss, ernd)
+            writer.scalar("Poison/Cumulative_Poison_Accuracy_Mean",
+                          state["cum_poison"][e] / ernd, ernd)
+            writer.scalar("Train/Loss", float(vals["train_loss"][e]),
+                          ernd)
+            if "fault_voters" in vals:
+                writer.scalar("Faults/Dropped",
+                              float(vals["fault_dropped"][e]), ernd)
+                writer.scalar("Faults/Straggled",
+                              float(vals["fault_straggled"][e]), ernd)
+                writer.scalar("Faults/Effective_Voters",
+                              float(vals["fault_voters"][e]), ernd)
+            if "churn_away" in vals:
+                writer.scalar("Churn/Sampled_Away",
+                              float(vals["churn_away"][e]), ernd)
+            tel = obs_telemetry.tenant_rows(vals, e,
+                                            allowed=tel_allowed[e])
+            obs_telemetry.emit_scalars(writer, tel, ernd)
+            writer.scalar("Throughput/Rounds_Per_Sec",
+                          rounds_done_now / elapsed, ernd)
+            if (state["t_steady"] is not None
+                    and rounds_done_now > state["r_steady"]):
+                writer.scalar("Throughput/Steady_Rounds_Per_Sec",
+                              (rounds_done_now - state["r_steady"])
+                              / (now - state["t_steady"]), ernd)
+            summary = {
+                "round": ernd, "val_loss": val_loss, "val_acc": val_acc,
+                "poison_loss": poison_loss, "poison_acc": poison_acc,
+                "rounds_per_sec": rounds_done_now / elapsed}
+            if tel:
+                summary["defense"] = obs_telemetry.host_summary(tel)
+            state["summaries"][e] = summary
+            writer.flush()
+        if state["t_steady"] is None:
+            state["t_steady"] = now
+            state["r_steady"] = rounds_done_now
+        else:
+            state["t_steady_end"] = now
+            state["r_steady_end"] = rounds_done_now
+
+    # --- the dispatch loop: the solo schedule, E experiments per unit ---
+    rounds_done = 0
+    loop_ok = False
+    t_loop = time.perf_counter()
+    try:
+        for unit in dispatch_schedule(0, rounds, snap, chain_n, False,
+                                      chained_fn is not None):
+            if len(unit) > 1:
+                ids = jnp.arange(unit[0], unit[-1] + 1)
+                params_E, stacked = chained_fn(params_E, base_keys_E, ids,
+                                               knobs)
+                rnd = unit[-1]
+                info = {k: v[-1] for k, v in stacked.items()}
+            else:
+                rnd = unit[0]
+                keys_E = fold(base_keys_E, rnd)
+                params_E, info = round_fn(params_E, keys_E,
+                                          jnp.int32(rnd), knobs)
+            rounds_done += len(unit)
+            if rnd % snap == 0:
+                vals = {"finite": all_finite_device(params_E)}
+                val_loss_d, val_acc_d, per_class_d = eval_val_fn(
+                    params_E, *val)
+                poison_loss_d, poison_acc_d, _ = eval_pval_fn(
+                    params_E, *pval)
+                vals.update(val_loss=val_loss_d, val_acc=val_acc_d,
+                            base_acc=per_class_d[:, rep.base_class],
+                            poison_loss=poison_loss_d,
+                            poison_acc=poison_acc_d,
+                            train_loss=info["train_loss"])
+                if "fault_voters" in info:
+                    vals.update({k: info[k] for k in FAULT_INFO_KEYS})
+                if "churn_away" in info:
+                    vals["churn_away"] = info["churn_away"]
+                vals.update({k: info[k] for k in info
+                             if k.startswith("tel_")})
+                elapsed = time.perf_counter() - t_loop
+                if drain is not None:
+                    drain.submit(emit, vals, rnd, rounds_done, elapsed)
+                else:
+                    vals = jax.device_get(vals)  # static: ok(host-sync)
+                    emit(vals, rnd, rounds_done, elapsed)
+        if drain is not None:
+            drain.flush()
+        loop_ok = True
+    finally:
+        if drain is not None:
+            drain.close(raise_errors=False)
+        if not loop_ok:
+            # a failed pack still flushes+releases every tenant's
+            # metrics handle (the queue records the failure and moves
+            # on; the success path closes writers after the memory
+            # rows below — close() is not re-entrant)
+            for writer in writers:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    elapsed = time.perf_counter() - t_loop
+    wall = time.perf_counter() - t0
+    pack_rps = rounds_done / max(elapsed, 1e-9)
+    steady_rps = None
+    if (state["t_steady"] is not None
+            and state["t_steady_end"] is not None
+            and state["r_steady_end"] > state["r_steady"]):
+        steady_rps = ((state["r_steady_end"] - state["r_steady"])
+                      / max(state["t_steady_end"] - state["t_steady"],
+                            1e-9))
+    mem = obs_attribution.memory_watermarks()
+    mem.update(obs_attribution.host_watermarks())
+    summaries = []
+    for e, (writer, cfg) in enumerate(zip(writers, cfgs, strict=True)):
+        if mem:
+            for tag, v in obs_attribution.memory_rows(mem):
+                writer.scalar(tag, v, rounds)
+        writer.close()
+        summary = dict(state["summaries"][e])
+        summary.setdefault("round", rounds)
+        summary["rounds_per_sec"] = pack_rps
+        if steady_rps is not None:
+            summary["steady_rounds_per_sec"] = steady_rps
+        summary["params"] = n_params
+        summaries.append(summary)
+    pack_info = {"tenants": E, "rounds": rounds,
+                 "wall_s": round(wall, 3),
+                 "compile_s": round(compile_s, 3),
+                 "rounds_per_sec": round(pack_rps, 4)}
+    if steady_rps is not None:
+        pack_info["steady_rounds_per_sec"] = round(steady_rps, 4)
+    print(f"[tenancy] pack done: {E} tenants x {rounds} rounds in "
+          f"{wall:.1f}s ({pack_rps:.2f} pack-rounds/sec)")
+    return summaries, pack_info

@@ -164,6 +164,16 @@ class RoundEngine:
         # one-shot trainer) keeps newest-valid semantics. The producer
         # (prepare_crash_exact_resume) has already digest-validated that
         # round, so restore skips re-hashing it.
+        if cfg.tenants > 0:
+            # the tenant axis is the experiment QUEUE's pack knob
+            # (service/queue.py --tenants routes shape-compatible cells
+            # through service/tenancy.run_pack); this engine runs ONE
+            # experiment and must never half-adopt the *_mt families
+            raise ValueError(
+                f"--tenants {cfg.tenants} packs experiments in the "
+                f"queue (service/queue.py --tenants E, or "
+                f"scripts/sweep_scenarios.py --tenants E); train.run "
+                f"runs a single experiment — drop --tenants here")
         resolved_layout = compile_cache.resolved_train_layout(cfg)
         if cfg.train_layout != resolved_layout:
             # same shape as the bucket+diagnostics refusal, but megabatch
@@ -1171,6 +1181,11 @@ class RoundEngine:
             self._emit_eval_body(vals, ernd, rounds_done_now, elapsed)
 
     def _emit_eval_body(self, vals, ernd, rounds_done_now, elapsed):
+        # service/tenancy.run_pack's emit() mirrors this row schema
+        # per tenant — a new scalar series added here must be fanned
+        # out there too, or packed tenants' streams silently diverge
+        # from their solo twins (the tenancy parity tests pin the
+        # series they exercise, not future ones)
         cfg, writer, mstate = self.cfg, self.writer, self.mstate
         finite_warn(vals["finite"], where=f"round {ernd}",
                     raise_error=cfg.debug_nan)

@@ -212,15 +212,18 @@ def resolved_train_layout(cfg) -> str:
 
 def family_suffix(cfg) -> str:
     """Program-family name suffix for the aggregation mode + resolved
-    training layout: buffered-async families (`round_async`, ...,
-    fl/buffered.py) and megabatch families (`round_mb`, ...) are DISTINCT
-    programs with distinct names — and they compose (`round_async_mb`) —
+    training layout + tenancy: buffered-async families (`round_async`,
+    ..., fl/buffered.py), megabatch families (`round_mb`, ...) and
+    tenant-pack families (`round_mt`, ..., fl/tenancy.py) are DISTINCT
+    programs with distinct names — and they compose (`round_mb_mt`) —
     so manifests, contracts and driver logs never conflate them."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
         buffered)
     sfx = "_async" if buffered.is_buffered(cfg) else ""
     if resolved_train_layout(cfg) == "megabatch":
         sfx += "_mb"
+    if getattr(cfg, "tenants", 0) > 0:
+        sfx += "_mt"
     return sfx
 
 
@@ -250,6 +253,17 @@ def fingerprint(cfg, family: str, example_args) -> str:
     # the RESOLVED layout keys the cache (a diagnostics-degraded
     # megabatch config runs the vmap programs — same key, no split)
     fields["train_layout"] = resolved_train_layout(cfg)
+    if fields.get("tenants", 0) > 0:
+        # tenant packs (fl/tenancy.py): the per-tenant scalar knobs are
+        # traced [E]-vector ARGUMENTS of the *_mt programs, so their
+        # config values must not split the cache — normalize them to the
+        # canonical rep. The one structural bit a knob carries (is the
+        # RLR vote built at all) survives as threshold 0/1.
+        fields.update(
+            server_lr=1.0,
+            robustLR_threshold=1 if fields["robustLR_threshold"] > 0 else 0,
+            attack_boost=1.0, attack_start=0, attack_stop=0,
+            attack_every=1)
     meta = {
         "family": family,
         "cfg": {k: repr(v) for k, v in sorted(fields.items())},
@@ -267,6 +281,40 @@ def fingerprint(cfg, family: str, example_args) -> str:
     }
     blob = json.dumps(meta, sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:20]
+
+
+def tenant_pack_key(cfg) -> str:
+    """Shape/program-compatibility key for tenant-pack grouping (ISSUE
+    13): two cells may share a tenant pack IFF their keys match. Derived
+    from the SAME field algebra as the AOT fingerprint — the config minus
+    the runtime knobs (EXCLUDED_FIELDS) minus the per-tenant scalar
+    knobs (fl/tenancy.TENANT_KNOB_FIELDS, which become traced
+    [E]-vectors) — rather than an ad-hoc key list, so a new
+    program-shaping field can never silently mix programs inside one
+    pack. One addition on top of the fingerprint fields: the dispatch
+    schedule (rounds/snap/chain) — runtime fields for the fingerprint,
+    but a pack advances every tenant in lockstep, so cells must agree
+    on it. The RLR threshold needs no structural split: a pack with ANY
+    defended tenant builds the vote (fl/tenancy.canonical_rep derives
+    the bit from its members), and a threshold-0 tenant's vote
+    degenerates to +server_lr on every coordinate — arithmetically the
+    undefended update. `tenants` itself is dropped — pack width is the
+    queue's choice, not the cell's."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.tenancy import (
+        TENANT_KNOB_FIELDS)
+    fields = dataclasses.asdict(cfg)
+    for name in EXCLUDED_FIELDS:
+        fields.pop(name, None)
+    for name in TENANT_KNOB_FIELDS:
+        fields.pop(name, None)
+    fields.pop("tenants", None)
+    fields["train_layout"] = resolved_train_layout(cfg)
+    fields["_schedule"] = (cfg.rounds, cfg.snap, cfg.chain)
+    meta = {"cfg": {k: repr(v) for k, v in sorted(fields.items())},
+            "jax": jax.__version__,
+            "backend": jax.default_backend()}
+    blob = json.dumps(meta, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -545,6 +593,42 @@ def plan_programs(cfg, model, norm, fed,
     m = cfg.agents_per_round
     specs: List[ProgramSpec] = []
 
+    if getattr(cfg, "tenants", 0) > 0:
+        # tenant-pack families (ISSUE 13, fl/tenancy.py): the experiment
+        # axis rides every carried array as a leading [E] dimension; the
+        # per-tenant scalar knobs are traced [E]-vector arguments
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+            tenancy)
+        rep = tenancy.canonical_rep(plain)
+        tenancy.check(rep)
+        E = rep.tenants
+        pE_aval = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct((E,) + a.shape, a.dtype),
+            params_aval)
+        keysE_aval = jax.ShapeDtypeStruct((E,) + key_aval.shape,
+                                          key_aval.dtype)
+        rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        kavals = tenancy.knob_avals(E)
+        specs.append(ProgramSpec(
+            "round" + sfx,
+            tenancy.make_tenant_round_fn(rep, model, norm,
+                                         *data_avals).jitted,
+            (pE_aval, keysE_aval, rnd_aval, kavals) + data_avals))
+        if chain_n > 1:
+            specs.append(ProgramSpec(
+                "chained" + sfx,
+                tenancy.make_tenant_chained_fn(rep, model, norm,
+                                               *data_avals).jitted,
+                (pE_aval, keysE_aval, ids_aval, kavals) + data_avals))
+        eval_mt = tenancy.make_tenant_eval_fn(model, norm, cfg.n_classes)
+        for family, (imgs, lbls) in (
+                ("eval_val_mt", (fed.val_images, fed.val_labels)),
+                ("eval_poison_mt", (fed.pval_images, fed.pval_labels))):
+            eval_avals = abstractify(pad_eval_set(imgs, lbls, cfg.eval_bs))
+            specs.append(ProgramSpec(family, eval_mt,
+                                     (pE_aval,) + eval_avals))
+        return specs
+
     if cohort_mode:
         # cohort-sampled families (ISSUE 7): data arrives as [m, ...]
         # cohort stacks like host mode, plus the traced round index the
@@ -662,6 +746,30 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
     plain = cfg.replace(diagnostics=False)
     m = cfg.agents_per_round
     specs: List[ProgramSpec] = []
+    if getattr(cfg, "tenants", 0) > 0:
+        # sharded tenant pack (ISSUE 13): the tenant axis folds INSIDE
+        # the shard (parallel/rounds.make_sharded_round_fn_mt) so the
+        # leaf/bucket collective plans are unchanged — the *_mt
+        # CheckSpecs pin that at 1/8/16-way
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+            tenancy)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.rounds import (
+            make_sharded_round_fn_mt)
+        rep = tenancy.canonical_rep(plain)
+        E = rep.tenants
+        pE_aval = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct((E,) + a.shape, a.dtype),
+            params_aval)
+        keysE_aval = jax.ShapeDtypeStruct((E,) + key_aval.shape,
+                                          key_aval.dtype)
+        rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
+        kavals = tenancy.knob_avals(E)
+        specs.append(ProgramSpec(
+            "round_sharded" + sfx,
+            make_sharded_round_fn_mt(rep, model, norm, mesh,
+                                     *data_avals).jitted,
+            (pE_aval, keysE_aval, rnd_aval, kavals) + data_avals))
+        return specs
     if is_cohort_mode(cfg, fed):
         shard_avals = tuple(
             jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)

@@ -223,6 +223,14 @@ def main(argv=None):
                     help="override the synthetic dataset size (forces "
                          "the synthetic generator; CI-scale smoke); "
                          "0 = flagship fmnist default")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help=">=2: multi-tenant packing (ISSUE 13, "
+                         "service/tenancy.py) — shape-compatible cells "
+                         "(grouped by the compile-cache fingerprint's "
+                         "field algebra) run up to E at a time as ONE "
+                         "resident *_mt program; incompatible cells "
+                         "fall back to the serial path with a printed "
+                         "note")
     ap.add_argument("--inject_bad_cell", action="store_true",
                     help="append a deliberately poisoned cell (unknown "
                          "aggregator) to prove the record-and-skip "
@@ -270,7 +278,8 @@ def main(argv=None):
           f"x {args.faults} x {args.regimes} (boost {args.boost}, "
           f"thr {thr}) -> {args.out}")
 
-    rows = run_queue(base, cells, results_path=args.out)
+    rows = run_queue(base, cells, results_path=args.out,
+                     tenants=args.tenants)
     ok = [r for r in rows if r["ok"]]
     for r in rows:
         if r["ok"]:

@@ -266,6 +266,20 @@ else
     say "WARN: agg-mode A/B rc=$?"
 fi
 
+say "step 6c: tenancy A/B (--tenants 8, ISSUE 13 — BENCH_NOTES r14)"
+# packed vs serial cells/hour on an equal 16-cell shape-compatible cell
+# list (seeds x thresholds) — the >10x headline call: the serial arm
+# pays the per-dispatch tunnel latency per tiny program, the packed arm
+# runs all E tenants as one resident *_mt program (the JSON's
+# tenancy_ab block carries both arms + the speedup)
+if run_bench logs/bench_r5_tenancy.txt --tenants 8; then
+    tail -1 logs/bench_r5_tenancy.txt > BENCH_TPU_r05_tenancy.json
+    say "tenancy A/B: $(cat BENCH_TPU_r05_tenancy.json)"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: tenancy A/B rc=$?"
+fi
+
 say "step 7/7: figures refresh"
 # NOT counted in SUCCESSES: plot_curves re-renders from a pre-existing
 # results.json, so it succeeds even when every measurement step failed —
@@ -283,7 +297,9 @@ python scripts/plot_curves.py >>"$LOG" 2>&1 || say "WARN: plot failed"
 PRESENT=""
 for f in BENCH_TPU_r05.json BENCH_TPU_r05_faults.json \
          BENCH_TPU_r05_train_layout.json \
-         BENCH_TPU_r05_train_layout_bf16.json sweep_faults.jsonl \
+         BENCH_TPU_r05_train_layout_bf16.json \
+         BENCH_TPU_r05_agg_mode.json BENCH_TPU_r05_tenancy.json \
+         sweep_faults.jsonl \
          results.json RESULTS.md performance.png \
          poison_acc.png BENCH_NOTES.md; do
     [ -e "$f" ] && git add -- "$f" 2>>"$LOG" && PRESENT="$PRESENT $f"

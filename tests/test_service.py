@@ -449,8 +449,14 @@ def test_queue_runs_cells_and_survives_a_poisoned_one(tmp_path,
     assert "injected cell failure" in rows[1]["error"]
     disk = [json.loads(line)
             for line in open(tmp_path / "queue_results.jsonl")]
-    assert [r["cell"] for r in disk] == ["good", "bad", "tail"]
-    assert disk[0]["summary"]["val_acc"] == 0.5
+    # the FINAL row is the queue-level throughput summary (ISSUE 13);
+    # every cell row precedes it and carries the resolved run_name
+    assert disk[-1]["queue_summary"] is True
+    assert disk[-1]["cells"] == 3 and disk[-1]["ok"] == 2
+    cell_rows = disk[:-1]
+    assert [r["cell"] for r in cell_rows] == ["good", "bad", "tail"]
+    assert all("run_name" in r for r in cell_rows)
+    assert cell_rows[0]["summary"]["val_acc"] == 0.5
     with pytest.raises(ValueError, match="unknown Config fields"):
         run_queue(base, [{"name": "x", "overrides": {"nope": 1}}])
 
