@@ -195,6 +195,15 @@ def main():
                     help="also measure rounds/sec at 30%% client dropout "
                          "(faults/ masking path) and report the masking "
                          "overhead vs the dense 0%% run")
+    ap.add_argument("--health", choices=("on", "off", "both"),
+                    default="on",
+                    help="in-program health sentinel lane "
+                         "(health/sentinel.py, default on — the shipped "
+                         "config). 'off' re-points the headline at the "
+                         "lane-free program; 'both' keeps the on "
+                         "headline and ALSO measures the off twin "
+                         "(health_ab in the output JSON — the ISSUE-14 "
+                         "<=1%% overhead acceptance A/B)")
     ap.add_argument("--telemetry", choices=("off", "basic", "full"),
                     default="off",
                     help="also measure rounds/sec with in-jit defense "
@@ -382,6 +391,10 @@ def main():
         # a single layout re-points the HEADLINE; 'both' keeps the vmap
         # headline and adds the A/B block below
         extra["train_layout"] = args.train_layout
+    if args.health == "off":
+        # 'off' re-points the headline; 'both' keeps the (default-on)
+        # headline and adds the health_ab block below
+        extra["health"] = "off"
     if cpu_fallback:
         extra["data_dir"] = "/nonexistent_use_synthetic_reduced"
     # BASELINE.json configs[1] (fmnist flagship) or configs[3] (resnet9,
@@ -615,6 +628,28 @@ def main():
         }
         log(f"[bench] telemetry={args.telemetry} overhead: "
             f"{telemetry_out['overhead_pct']}%")
+
+    health_ab_out = None
+    if args.health == "both":
+        # health-lane overhead A/B (ISSUE 14): same config with the
+        # in-jit sentinel compiled OUT of the round program; the on
+        # headline vs the off twin is the cost of the lane's reductions
+        # (acceptance: <=1% on steady rounds/sec — the sharded scalars
+        # pack into the loss psum, so there is no collective delta to
+        # pay, only the reduction arithmetic)
+        hb.update(phase="health_ab", force=True)
+        _, r_hoff, c_hoff, _ = measure(cfg.replace(health="off"),
+                                       label="[health off]")
+        health_ab_out = {
+            "on_rounds_per_sec": round(rounds_per_sec, 4),
+            "off_rounds_per_sec": round(r_hoff, 4),
+            "overhead_pct": round(
+                100.0 * (1.0 - rounds_per_sec / r_hoff), 2),
+            "compile_s_off": round(c_hoff, 1),
+        }
+        log(f"[bench] health-lane overhead: "
+            f"{health_ab_out['overhead_pct']}% "
+            f"(on {rounds_per_sec:.3f} vs off {r_hoff:.3f} r/s)")
 
     population_out = None
     if args.population_ladder:
@@ -1147,6 +1182,9 @@ def main():
         out["faults"] = faults_out
     if telemetry_out is not None:
         out["telemetry"] = telemetry_out
+    out["health"] = cfg.health
+    if health_ab_out is not None:
+        out["health_ab"] = health_ab_out
     if population_out is not None:
         out["population"] = population_out
     if attribution_out is not None:

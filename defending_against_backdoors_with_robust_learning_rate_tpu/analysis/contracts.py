@@ -54,6 +54,10 @@ HOT_PATH_MODULES = (
     f"{PKG}/attack/schedule.py",
     f"{PKG}/attack/boost.py",
     f"{PKG}/attack/signflip.py",
+    # in-jit health sentinel (ISSUE 14): its reductions run inside every
+    # round program (the host-side half lives in health/monitor.py,
+    # which is deliberately NOT hot-path scope)
+    f"{PKG}/health/sentinel.py",
 )
 
 # Function-level exemptions: (repo-relative path, function qualname prefix)
@@ -666,6 +670,61 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         sharded=True, cfg_overrides={**mt, "agg_layout": "bucket"},
         collective_budget=dict(rs_budget),
         hlo_all_reduce_max=2 + spmd_overhead)
+
+    # in-program health lane + quarantine mask (ISSUE 14, health/): the
+    # sentinel is pure jnp reductions on data the body already holds, and
+    # the sharded scalar lanes PACK into the loss psum the body already
+    # pays (pmean's scalar psum becomes one [3]-vector psum — a shape
+    # change, never a count change; the buffered mode appends to its
+    # existing packed-lane psum the same way). The quarantine set is a
+    # traced membership CONSTANT feeding the participation-mask protocol
+    # (the churn idiom). The acceptance claim is therefore ZERO
+    # collectives beyond each family's pinned plan on EVERY dispatch
+    # surface, at 1/8/16-way (contracts.TOPOLOGIES), jaxpr + compiled
+    # HLO. `health` defaults ON, so every spec above already traces the
+    # lane — these `*_hlth` twins pin it EXPLICITLY (surviving a default
+    # flip) and compose it with an armed quarantine set; the `_off` twin
+    # pins that the bench A/B arm really removes the lane from the vmap
+    # program.
+    hlth = {"health": "on", "quarantine": "1,3"}
+    specs["vmap_rlr_avg_hlth"] = CheckSpec(
+        name="vmap_rlr_avg_hlth", family="round", sharded=False,
+        cfg_overrides=dict(hlth), collective_budget=dict(zero))
+    specs["vmap_rlr_avg_hlth_off"] = CheckSpec(
+        name="vmap_rlr_avg_hlth_off", family="round", sharded=False,
+        cfg_overrides={"health": "off"}, collective_budget=dict(zero))
+    specs["sharded_rlr_avg_hlth"] = CheckSpec(
+        name="sharded_rlr_avg_hlth", family="round_sharded",
+        sharded=True, cfg_overrides=dict(hlth),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_hlth"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_hlth", family="round_sharded",
+        sharded=True, cfg_overrides={**hlth, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_hlth"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_hlth",
+        family="round_sharded_cohort", sharded=True,
+        cfg_overrides={**hlth, "cohort_sampled": "on"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_mb_hlth"] = CheckSpec(
+        name="sharded_rlr_avg_mb_hlth", family="round_sharded_mb",
+        sharded=True,
+        cfg_overrides={**hlth, "train_layout": "megabatch"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_async_hlth"] = CheckSpec(
+        name="sharded_rlr_avg_async_hlth", family="round_sharded_async",
+        sharded=True, cfg_overrides={**hlth, "agg_mode": "buffered"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_mt_hlth"] = CheckSpec(
+        name="sharded_rlr_avg_mt_hlth", family="round_sharded_mt",
+        sharded=True, cfg_overrides={**hlth, "tenants": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
     return specs
 
 
@@ -701,6 +760,13 @@ PROGRAM_READ_MODULES = (
     f"{PKG}/attack/schedule.py",
     f"{PKG}/attack/boost.py",
     f"{PKG}/attack/signflip.py",
+    # health lane (ISSUE 14): the traced sentinel reads cfg.health (the
+    # lane is a program difference, like telemetry) and cfg.quarantine
+    # (a traced membership constant, like churn_seed) — both program
+    # provenance. (health/monitor.py is NOT in scope: the host-side
+    # policy legitimately reads runtime fields like health_policy and
+    # the EMA judgement knobs.)
+    f"{PKG}/health/sentinel.py",
 )
 
 # Provenance classes (config.FIELD_PROVENANCE values) and their

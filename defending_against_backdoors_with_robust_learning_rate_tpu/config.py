@@ -259,6 +259,43 @@ class Config:
                                     # Normally set by the experiment queue
                                     # (service/queue.py --tenants), not by
                                     # hand.
+    # --- in-program health lane + auto-recovery (health/, ISSUE 14) ---
+    health: str = "on"              # on | off — the always-on in-jit
+                                    # numerics sentinel (health/sentinel):
+                                    # per-round nonfinite update counts,
+                                    # committed-params finite bit and the
+                                    # cohort update-norm mass emitted as
+                                    # Health/* rows, with ZERO added
+                                    # collectives (the sharded scalars
+                                    # pack into the loss psum's lanes).
+                                    # off removes the lane from the
+                                    # traced program (the bench A/B arm)
+    health_policy: str = "record"   # abort | recover | record — what a
+                                    # numerics incident does
+                                    # (health/monitor.py): abort raises
+                                    # (--debug_nan forces this), record
+                                    # warns loudly and keeps the metrics
+                                    # flowing (the sweep default: a NaN
+                                    # cell is recorded-and-skipped),
+                                    # recover arms the service driver's
+                                    # ladder (discard -> rollback ->
+                                    # quarantine -> halt)
+    health_z_threshold: float = 6.0  # loss z-score (vs the carried EMA
+                                    # baseline) above which a boundary is
+                                    # an incident
+    health_spike_factor: float = 10.0  # update-norm spike trigger: norm >
+                                    # factor x its EMA baseline
+    quarantine: str = ""            # comma-separated client ids excluded
+                                    # from every round's participation
+                                    # mask (the ladder's QUARANTINE rung
+                                    # writes this; a traced program
+                                    # constant — the churn protocol,
+                                    # zero extra collectives)
+    bank_verify: bool = False       # verify the client bank's per-shard
+                                    # sha256 sidecars on open (data/bank):
+                                    # a corrupted indices-*.bin fails
+                                    # loudly naming the shard instead of
+                                    # feeding garbage batches
     # --- continuous-service driver (service/driver.py) ---
     service_rounds: int = 0         # serve(): total rounds to stream; 0 =
                                     # indefinitely (until the stop file
@@ -514,6 +551,18 @@ FIELD_PROVENANCE = {
     "bank_dir": "runtime",         # storage location only
     "bank_shard_clients": "runtime",  # IO shard layout; bank content is
                                       # layout-independent (test-pinned)
+    "health": "program",           # the in-jit sentinel adds outputs to
+                                   # (and packs lanes into) the traced
+                                   # round program — a program difference
+                                   # like telemetry
+    "health_policy": "runtime",    # host-side incident policy; never
+                                   # read in a trace
+    "health_z_threshold": "runtime",   # host-side EMA judgement knobs
+    "health_spike_factor": "runtime",  # (health/monitor.py)
+    "quarantine": "program",       # the quarantined-id set is a traced
+                                   # membership constant (the churn_seed
+                                   # idiom: baked in, keys the cache)
+    "bank_verify": "runtime",      # open-time IO verification only
     "service_rounds": "runtime",   # service/driver.py streaming budget
     "service_retries": "runtime",  # supervisor policy (service/supervisor)
     "service_backoff_s": "runtime",
@@ -808,6 +857,38 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                         "thresholds/LRs as traced [E]-vectors; normally "
                         "driven by the experiment queue "
                         "(service/queue.py --tenants), 0 = solo paths")
+    p.add_argument("--health", choices=("on", "off"), default=d.health,
+                   help="in-program numerics health lane "
+                        "(health/sentinel.py): per-round nonfinite "
+                        "counts + committed-params finite bit + update-"
+                        "norm mass as Health/* rows, zero added "
+                        "collectives; off removes the lane (bench A/B)")
+    p.add_argument("--health_policy", choices=("abort", "recover",
+                                               "record"),
+                   default=d.health_policy,
+                   help="numerics-incident policy (health/monitor.py): "
+                        "abort raises (--debug_nan forces it), record "
+                        "warns and keeps recording (sweep default), "
+                        "recover arms the service driver's recovery "
+                        "ladder (discard -> rollback -> quarantine -> "
+                        "halt)")
+    p.add_argument("--health_z_threshold", type=float,
+                   default=d.health_z_threshold,
+                   help="loss z-score vs the carried EMA above which a "
+                        "boundary counts as a health incident")
+    p.add_argument("--health_spike_factor", type=float,
+                   default=d.health_spike_factor,
+                   help="update-norm spike trigger: norm > factor x its "
+                        "EMA baseline")
+    p.add_argument("--quarantine", type=str, default=d.quarantine,
+                   help="comma-separated client ids excluded from every "
+                        "round's participation mask (the recovery "
+                        "ladder's QUARANTINE rung; zero extra "
+                        "collectives — the churn protocol)")
+    p.add_argument("--bank_verify", action="store_true",
+                   help="verify the client bank's per-shard sha256 "
+                        "sidecars on open; a corrupted indices-*.bin "
+                        "fails loudly naming the shard")
     p.add_argument("--service_rounds", type=int, default=d.service_rounds,
                    help="service mode: total rounds to stream (0 = run "
                         "until <log_dir>/service.stop appears)")

@@ -50,6 +50,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays impor
 BANK_VERSION = 1
 META_NAME = "meta.json"
 OFFSETS_NAME = "offsets.npy"
+DIGEST_SUFFIX = ".sha256"
 
 # fixed generation block for the per-client-seeded partitioners: content is
 # a function of (seed, block index) with BUILD_BLOCK a named constant, so
@@ -284,6 +285,53 @@ class ClientBank:
         return cls(bank_dir, meta, offsets)
 
 
+class BankCorrupted(ValueError):
+    """A shard's bytes disagree with its sha256 sidecar — real on-disk
+    damage, never a stale-config condition ``get_or_build`` may silently
+    rebuild over."""
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_digests(bank_dir: str, log=print) -> int:
+    """Data-plane integrity (ISSUE 14): check every ``indices-*.bin``
+    shard against its ``.sha256`` sidecar (written at build — presence
+    is atomic with the bank's publish rename). A mismatch raises a loud,
+    actionable error NAMING the shard: a silently corrupted index shard
+    would otherwise feed garbage batches to every cohort that touches
+    its clients. Shards without a sidecar (a pre-digest legacy bank) are
+    skipped with a note. Returns the number of shards verified."""
+    names = sorted(n for n in os.listdir(bank_dir)
+                   if n.startswith("indices-") and n.endswith(".bin"))
+    checked = 0
+    for name in names:
+        path = os.path.join(bank_dir, name)
+        sidecar = path + DIGEST_SUFFIX
+        if not os.path.exists(sidecar):
+            log(f"[bank] {name}: no digest sidecar (pre-digest bank) — "
+                f"skipping verification for this shard")
+            continue
+        with open(sidecar, encoding="utf-8") as f:
+            want = f.read().strip()
+        have = _file_sha256(path)
+        if have != want:
+            raise BankCorrupted(
+                f"client bank shard CORRUPTED: {path} hashes to "
+                f"{have[:16]}… but its sidecar records {want[:16]}… — "
+                f"the bank on disk is damaged (bad disk, torn copy, or "
+                f"tampering). Delete the bank directory ({bank_dir}) to "
+                f"rebuild it deterministically, or restore it from a "
+                f"good copy.")
+        checked += 1
+    return checked
+
+
 def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
                partitioner: str = "dirichlet", samples_per_client: int = 0,
                dirichlet_alpha: float = 0.5, classes_per_client: int = 2,
@@ -314,6 +362,21 @@ def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
     total = 0
     shard_f = None
     shard_id = -1
+    shard_sha = None
+
+    def close_shard():
+        # finalize the open shard: close it and land its sha256 sidecar
+        # (data-plane integrity, ISSUE 14 — verify_digests checks it on
+        # every --bank_verify open). Sidecars are written inside the tmp
+        # dir, so they publish atomically with the bank's rename.
+        nonlocal shard_f, shard_sha
+        if shard_f is not None:
+            path = shard_f.name
+            shard_f.close()
+            shard_f = None
+            with open(path + DIGEST_SUFFIX, "w", encoding="utf-8") as sf:
+                sf.write(shard_sha.hexdigest() + "\n")
+
     try:
         for start, lists in _iter_client_lists(
                 labels, population=population, partitioner=partitioner,
@@ -324,21 +387,21 @@ def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
                 cid = start + j
                 s = cid // shard_clients
                 if s != shard_id:
-                    if shard_f is not None:
-                        shard_f.close()
+                    close_shard()
                     shard_id = s
+                    shard_sha = hashlib.sha256()
                     shard_f = open(os.path.join(
                         tmp, f"indices-{s:05d}.bin"), "wb")
                 buf = np.ascontiguousarray(idx, dtype=np.int64).tobytes()
                 shard_f.write(buf)
                 sha.update(buf)
+                shard_sha.update(buf)
                 n = len(idx)
                 max_client_n = max(max_client_n, n)
                 total += n
                 offsets[cid + 1] = total
     finally:
-        if shard_f is not None:
-            shard_f.close()
+        close_shard()
     np.save(os.path.join(tmp, OFFSETS_NAME), offsets)
     meta = {
         "version": BANK_VERSION, "key": key, "content_sha": sha.hexdigest(),
@@ -377,12 +440,17 @@ def get_or_build(bank_dir: str, labels: np.ndarray, *, population: int,
                  partitioner: str, samples_per_client: int,
                  dirichlet_alpha: float, classes_per_client: int,
                  seed: int, n_classes: int, shard_clients: int,
-                 key: Optional[str] = None, log=print
-                 ) -> Tuple[ClientBank, bool]:
+                 key: Optional[str] = None, verify: bool = False,
+                 log=print) -> Tuple[ClientBank, bool]:
     """Open `bank_dir` when its key matches this config, else (re)build.
     Returns (bank, built). `key` = precomputed bank_key of these inputs
     (the labels sha256 is the expensive part — callers that already
-    computed it to resolve the bank dir pass it through)."""
+    computed it to resolve the bank dir pass it through). ``verify``
+    (--bank_verify) checks every reused shard against its sha256
+    sidecar before the first gather — a corrupted bank fails loudly
+    naming the shard instead of feeding garbage batches (a fresh build
+    is trusted: the sidecars were just computed from the written
+    bytes)."""
     labels = np.asarray(labels)
     spc = resolve_samples_per_client(samples_per_client, len(labels),
                                      population)
@@ -397,9 +465,18 @@ def get_or_build(bank_dir: str, labels: np.ndarray, *, population: int,
         try:
             bank = ClientBank.open(bank_dir)
             if bank.meta.get("key") == key:
+                if verify:
+                    # a digest MISMATCH stays loud (BankCorrupted is not
+                    # caught below): silently rebuilding would hide real
+                    # disk damage behind a multi-minute rebuild
+                    n = verify_digests(bank_dir, log=log)
+                    log(f"[bank] {bank_dir}: {n} shard digest(s) "
+                        f"verified (--bank_verify)")
                 return bank, False
             log(f"[bank] {bank_dir}: key mismatch "
                 f"(have {bank.meta.get('key')}, want {key}); rebuilding")
+        except BankCorrupted:
+            raise
         except (OSError, ValueError) as e:
             log(f"[bank] {bank_dir}: unreadable ({e}); rebuilding")
         shutil.rmtree(bank_dir, ignore_errors=True)

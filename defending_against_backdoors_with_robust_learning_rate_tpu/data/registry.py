@@ -118,14 +118,22 @@ class CohortData(FederatedData):
         return imgs, lbls, sizes
 
 
-def resolve_bank_dir(cfg, key: str) -> str:
-    """--bank_dir wins; otherwise banks live under
-    <data_dir>/client_banks/ when data_dir exists (persistent across
-    runs, gitignored), else under log_dir (always writable)."""
+def resolve_bank_root(cfg) -> str:
+    """The client-bank ROOT this config would use: --bank_dir wins;
+    otherwise <data_dir>/client_banks when data_dir exists (persistent
+    across runs, gitignored), else under log_dir (always writable).
+    Shared with the chaos bank_corrupt drill (service/driver.py), which
+    must search the same root the engine will open."""
     if cfg.bank_dir:
         return cfg.bank_dir
     base = (cfg.data_dir if os.path.isdir(cfg.data_dir) else cfg.log_dir)
-    return os.path.join(base, "client_banks", f"{cfg.data}-{key[:12]}")
+    return os.path.join(base, "client_banks")
+
+
+def resolve_bank_dir(cfg, key: str) -> str:
+    if cfg.bank_dir:
+        return cfg.bank_dir
+    return os.path.join(resolve_bank_root(cfg), f"{cfg.data}-{key[:12]}")
 
 
 def get_cohort_data(cfg) -> CohortData:
@@ -159,7 +167,7 @@ def get_cohort_data(cfg) -> CohortData:
         dirichlet_alpha=cfg.dirichlet_alpha,
         classes_per_client=cfg.classes_per_client, seed=cfg.seed,
         n_classes=cfg.n_classes, shard_clients=cfg.bank_shard_clients,
-        key=key)
+        key=key, verify=cfg.bank_verify)
     if not built:
         print(f"[bank] opened existing {cfg.partitioner} bank "
               f"({bank.population:,} clients) at {bank.dir}")

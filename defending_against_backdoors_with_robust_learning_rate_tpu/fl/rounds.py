@@ -28,6 +28,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
     buffered)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.client import (
     make_local_train, make_local_train_megabatch)
+from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+    sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import loops
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     aggregate_updates, apply_aggregate, robust_lr)
@@ -62,6 +64,9 @@ def _pallas_applicable(cfg) -> bool:
     # step, which the fused kernel's one-pass read would skip.
     # tenant packs (fl/tenancy.py) carry per-tenant thresholds/LRs as
     # traced knobs, which the fused kernel bakes as Python floats
+    # a quarantine set (health/monitor.py QUARANTINE rung) rides the
+    # participation mask, which the fused kernel does not take — same
+    # fallback as faults/churn
     return (bool(cfg.use_pallas) and cfg.aggr in ("avg", "sign")
             and cfg.noise == 0 and not cfg.diagnostics
             and not cfg.faults_enabled and not cfg.churn_enabled
@@ -69,6 +74,7 @@ def _pallas_applicable(cfg) -> bool:
             and not compile_cache.is_cohort_mode(cfg)
             and not buffered.is_buffered(cfg)
             and cfg.tenants == 0
+            and not health_sentinel.has_quarantine(cfg)
             and cfg.telemetry == "off")
 
 
@@ -328,6 +334,10 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 agg, mask=mask, corrupt_flags=corrupt_flags,
                 sign_sums=vote_sign,
                 vote_range=buffered.vote_range(cfg)))
+        if health_sentinel.health_on(cfg):
+            with jax.named_scope("health"):
+                extras.update(health_sentinel.sentinel(
+                    cfg, updates, new_params, mask=mask))
         return new_params, jnp.mean(losses), extras, new_astate
     if _pallas_applicable(cfg):   # never taken when faults are configured
         from defending_against_backdoors_with_robust_learning_rate_tpu.ops.pallas_rlr import (
@@ -336,7 +346,14 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
             params, updates, sizes.astype(jnp.float32),
             float(cfg.robustLR_threshold), cfg.effective_server_lr,
             interpret=jax.default_backend() != "tpu", mode=cfg.aggr)
-        return new_params, jnp.mean(losses), {}
+        extras = {}
+        if health_sentinel.health_on(cfg):
+            # the sentinel reads the stacked updates + committed params
+            # with plain jnp reductions OUTSIDE the fused kernel — the
+            # kernel's one-pass HBM property is untouched
+            with jax.named_scope("health"):
+                extras = health_sentinel.sentinel(cfg, updates, new_params)
+        return new_params, jnp.mean(losses), extras
     slr = (cfg.effective_server_lr if knobs is None
            else knobs.server_lr)
     with jax.named_scope("aggregate_rlr"):
@@ -367,6 +384,10 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         extras["agent_norms"] = per_agent_norms(updates)
         if cfg.robustLR_threshold > 0:
             extras["lr_flat"] = ravel_pytree(lr)[0]
+    if health_sentinel.health_on(cfg):
+        with jax.named_scope("health"):
+            extras.update(health_sentinel.sentinel(
+                cfg, updates, new_params, mask=mask))
     return new_params, jnp.mean(losses), extras
 
 
@@ -395,10 +416,10 @@ def make_chained(step, data, family: str = "chained"):
             out = {"train_loss": info["train_loss"],
                    "sampled": info["sampled"]}
             out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
-            # telemetry scalars (obs/telemetry.py) ride the scan stacked
-            # per-round, like the fault counters
+            # telemetry — and health-sentinel — scalars ride the scan
+            # stacked per-round, like the fault counters
             out.update({k: v for k, v in info.items()
-                        if k.startswith("tel_")})
+                        if k.startswith(("tel_", "hlth_"))})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short
@@ -453,6 +474,13 @@ def _make_sample_step(cfg, model, normalize):
                 churn as churn_mod)
             with jax.named_scope("churn_mask"):
                 churn_active = churn_mod.active_slots(cfg, sampled, rnd)
+        if health_sentinel.has_quarantine(cfg):
+            # quarantined clients (health/monitor.py QUARANTINE rung)
+            # leave the electorate through the participation mask — a
+            # traced-constant membership test, the churn protocol
+            qmask = health_sentinel.quarantine_mask(cfg, sampled)
+            churn_active = (qmask if churn_active is None
+                            else churn_active & qmask)
         res = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
             train_block=train_block, cfg=cfg,
@@ -562,6 +590,13 @@ def make_host_step(cfg, model, normalize, take_flags=None):
             "--agg_mode buffered is not supported in host-sampled mode; "
             "run device-resident (--host_sampled off) or cohort-sampled "
             "(--cohort_sampled on)")
+    if health_sentinel.has_quarantine(cfg):
+        # same contract as churn: the host-sampled program never sees the
+        # sampled client ids the quarantine membership test hashes
+        raise ValueError(
+            "--quarantine is not supported in host-sampled mode (the "
+            "program never sees the sampled client ids); run "
+            "device-resident (--host_sampled off) or cohort-sampled")
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
         registry as attack_registry)
     if attack_registry.needs_round(cfg):
@@ -641,7 +676,7 @@ def make_chained_host(step):
             out = {"train_loss": info["train_loss"]}
             out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
             out.update({k: v for k, v in info.items()
-                        if k.startswith("tel_")})
+                        if k.startswith(("tel_", "hlth_"))})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short chains
@@ -698,6 +733,11 @@ def make_cohort_step(cfg, model, normalize):
         params, astate = carry if is_async else (carry, None)
         with jax.named_scope("cohort_sample"):
             ids, active = cohort_mod.sample_cohort(cfg, rnd)
+        if health_sentinel.has_quarantine(cfg):
+            # quarantined cohort members join the shortfall-padding /
+            # churn-absence protocol: excluded from aggregation through
+            # the active mask, zero extra collectives
+            active = active & health_sentinel.quarantine_mask(cfg, ids)
         k_train, k_noise = jax.random.split(key)
         res = _round_core(
             params, k_train, k_noise, imgs, lbls, sizes,

@@ -1,0 +1,27 @@
+"""In-program health sentinels + deterministic auto-recovery (ISSUE 14).
+
+Two halves, mirroring the telemetry/service split the repo already uses:
+
+- ``health/sentinel.py`` — the in-jit lane: per-round nonfinite counts,
+  the committed-params finite bit and the cohort update-norm mass,
+  computed INSIDE every compiled round program with ZERO added
+  collectives (the sharded paths pack the scalars into the loss psum's
+  lanes), plus the pure host-side EMA / z-score / spike math and the
+  quarantine participation mask.
+- ``health/monitor.py`` — the host-side policy: the unified divergence
+  policy (``--health_policy abort|recover|record`` — ``--debug_nan``
+  forces abort) every metrics boundary routes through, and the
+  deterministic auto-recovery ladder the service driver runs under
+  ``recover``: DISCARD -> ROLLBACK -> QUARANTINE -> HALT, every
+  transition counted, journaled and crash-exact.
+"""
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.health.sentinel import (  # noqa: F401
+    boundary_keys, has_quarantine, health_keys, health_on, quarantine_ids,
+    quarantine_mask)
+from defending_against_backdoors_with_robust_learning_rate_tpu.health.monitor import (  # noqa: F401
+    HealthIncident, HealthLadder, HealthRecovery, assess, check, ema_init,
+    emit_rows, enforce, resolve_policy)
+# NOTE: the `sentinel` NAME is deliberately not re-exported — it would
+# shadow the health.sentinel SUBMODULE on the package object, breaking
+# every `from ...health import sentinel as health_sentinel` importer.

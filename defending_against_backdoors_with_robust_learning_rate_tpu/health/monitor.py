@@ -1,0 +1,431 @@
+"""Host-side health policy: one divergence code path + the recovery ladder.
+
+**Unified divergence policy.** Before this module, bad math had two
+uncoordinated endpoints: ``--debug_nan`` aborted at the eval boundary and
+``utils/guards.finite_warn`` printed a warning on the async drain path.
+Both now route through ``assess``/``resolve_policy`` at the single metrics
+emit site (train._emit_eval_body):
+
+    abort    raise on a nonfinite boundary (``--debug_nan`` forces this);
+    record   warn loudly, emit the Health/* rows, keep recording — the
+             sweep default: a NaN cell is recorded-and-skipped by the
+             queue, never a dead matrix;
+    recover  same emission, plus the service driver runs the ladder.
+
+**The deterministic auto-recovery ladder** (``serve`` under
+``--health_policy recover``): at every eval boundary the driver fetches
+the round's sentinel lanes (health/sentinel.py) and, on an incident,
+walks DISCARD -> ROLLBACK -> QUARANTINE -> HALT:
+
+    DISCARD      withdraw the unit's commit (params were retained — the
+                 per-round families deliberately do not donate) and
+                 re-dispatch the same round with a recovery nonce folded
+                 into the round key: a transient numerics fault (one bad
+                 batch draw, a bf16 edge) heals in place;
+    ROLLBACK     tear the engine down and re-enter serve through the
+                 crash-exact machinery: restore the newest digest-valid
+                 checkpoint, truncate metrics.jsonl to its journaled
+                 offset, replay — exactly what a kill -9 recovery does,
+                 so a kill mid-rollback resumes the LADDER (this state
+                 file), not the failure;
+    QUARANTINE   feed the incident's suspect clients into the
+                 participation mask (``--quarantine``, a traced program
+                 constant — zero extra collectives, the churn protocol)
+                 and re-enter from the checkpoint;
+    HALT         raise loudly with the journal intact.
+
+Every rung is counted and journaled: the ladder state lives in an
+atomically-rewritten ``health_state.json`` (the chaos-state idiom), each
+transition lands in ``status.json`` as a phase, and the per-rung counters
+ride the run summary's ``service`` section.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+    sentinel)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.checkpoint import (
+    atomic_write_text)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
+    all_finite_device, finite_warn)
+
+STATE_NAME = "health_state.json"
+# ladder budgets per incident episode (a healthy boundary closes the
+# episode): deterministic constants, not config — the ladder's value is
+# that its walk is predictable enough to drill in CI
+MAX_DISCARDS = 1
+MAX_ROLLBACKS = 1
+MAX_QUARANTINED = 32
+# recovery nonce base folded into the round key on a DISCARD re-dispatch:
+# far outside the round-id range, so recovery streams never collide with
+# any round's own fold_in derivation
+RECOVERY_NONCE = 1_000_003
+# per-PROCESS ceiling on ladder re-entries (each ROLLBACK/QUARANTINE
+# re-enters serve() recursively; episodes reset on healthy boundaries, so
+# a long-lived service healing many incidents would otherwise creep
+# toward the interpreter's recursion limit). A restart is free — the
+# crash-exact resume + the ladder state file carry everything across it.
+MAX_REENTRIES_PER_PROCESS = 50
+
+RUNGS = ("discard", "rollback", "quarantine", "halt")
+POLICIES = ("abort", "recover", "record")
+
+TAGS = {
+    "nonfinite": "Health/Nonfinite_Updates",
+    "params_finite": "Health/Params_Finite",
+    "update_norm": "Health/Update_Norm",
+    "loss_z": "Health/Loss_Z",
+    "norm_spike": "Health/Norm_Spike",
+}
+
+
+def check(cfg) -> None:
+    """Validate the health flags loudly, before any build. Lives here
+    (not in sentinel.py) because ``health_policy`` is a runtime field:
+    sentinel.py is in the fingerprint audit's program-read scope
+    (contracts.PROGRAM_READ_MODULES), where a runtime read is a
+    violation."""
+    if cfg.health not in sentinel.LEVELS:
+        raise ValueError(f"--health must be one of {sentinel.LEVELS}, "
+                         f"got {cfg.health!r}")
+    if cfg.health_policy not in POLICIES:
+        raise ValueError(f"--health_policy must be one of {POLICIES}, "
+                         f"got {cfg.health_policy!r}")
+    if cfg.quarantine and not sentinel.quarantine_ids(cfg):
+        # a non-empty value that parses to ZERO ids ("," etc.) is an
+        # operator mistake, not an empty quarantine — refuse it before
+        # it half-arms the mask path
+        raise ValueError(
+            f"--quarantine {cfg.quarantine!r} contains no client ids; "
+            f"pass a comma-separated id list or leave it empty")
+    if cfg.quarantine:
+        sentinel.quarantine_ids(cfg)   # validates the id list loudly
+
+
+def resolve_policy(cfg) -> str:
+    """The single source of the divergence policy: ``--debug_nan`` is the
+    historical hard-abort switch and forces ``abort``; otherwise the
+    ``--health_policy`` flag decides."""
+    return "abort" if cfg.debug_nan else cfg.health_policy
+
+
+class HealthIncident(FloatingPointError):
+    """A numerics incident under the ``abort`` policy (or the ladder's
+    HALT rung). FloatingPointError keeps the historical --debug_nan
+    contract for callers that catch it."""
+
+
+def assess(cfg, state, vals) -> Dict:
+    """Judge one eval boundary's (host-fetched) values against the
+    carried EMA state. Pure: returns a report dict with the Health/* row
+    values, the incident verdict and the post-boundary EMA state —
+    callers commit ``new_state`` LAST (the cum_poison_acc discipline:
+    a supervised retry of the boundary must not double-fold the EMA).
+
+    Works with or without the in-jit lane: when ``--health off`` only
+    the boundary finite bit (vals['finite']) is judged and no rows are
+    produced."""
+    state = state or sentinel.ema_init()
+    finite = bool(vals.get("finite", True))
+    lane = "hlth_nonfinite" in vals
+    report = {"rows": {}, "new_state": state, "healthy": True,
+              "finite": finite, "why": ""}
+    if not lane:
+        report["healthy"] = finite
+        if not finite:
+            report["why"] = "nonfinite parameters"
+        return report
+    nonfinite = float(vals["hlth_nonfinite"])
+    pfinite = float(vals["hlth_params_finite"])
+    loss = float(vals["train_loss"])
+    nsq = float(vals["hlth_update_normsq"])
+    norm = math.sqrt(nsq) if (math.isfinite(nsq) and nsq >= 0) else nsq
+    z = sentinel.loss_z(state, loss)
+    spike = sentinel.norm_spike(state, norm, cfg.health_spike_factor)
+    # the committed-delta norm lane exists only on the service ladder's
+    # boundary check (HealthLadder.check) — it catches a magnitude fault
+    # in the COMMIT at the boundary it happened, before the checkpoint;
+    # the loss z-score alone would see it one boundary too late
+    delta = float(vals.get("hlth_delta_norm", float("nan")))
+    dspike = sentinel.delta_spike(state, delta, cfg.health_spike_factor)
+    bad_params = not finite or pfinite < 1.0
+    why = []
+    if bad_params:
+        why.append("nonfinite parameters")
+    if nonfinite > 0:
+        why.append(f"{int(nonfinite)} nonfinite client update(s)")
+    if z > cfg.health_z_threshold:
+        why.append(f"loss z-score {z:.1f} > {cfg.health_z_threshold}")
+    if spike:
+        why.append(f"update-norm spike (> {cfg.health_spike_factor}x EMA)")
+    if dspike:
+        why.append(f"committed-delta norm spike "
+                   f"(> {cfg.health_spike_factor}x EMA)")
+    # a finite-coordinate burst big enough to OVERFLOW the squared-norm
+    # accumulation shows up as inf mass with zero nonfinite rows — the
+    # spike comparisons above are isfinite-gated, so this must be its
+    # own incident or the most catastrophic magnitude event would pass
+    if not math.isfinite(norm):
+        why.append("non-finite update-norm mass (magnitude overflow)")
+    if not math.isnan(delta) and not math.isfinite(delta):
+        why.append("non-finite committed-delta norm (magnitude overflow)")
+    healthy = not why
+    report.update(
+        healthy=healthy, why="; ".join(why), finite=not bad_params,
+        rows={"nonfinite": nonfinite, "params_finite": pfinite,
+              "update_norm": norm, "loss_z": z,
+              "norm_spike": 1.0 if spike else 0.0},
+        # incident boundaries do not move the baseline they were judged
+        # against (sentinel.ema_update docstring)
+        new_state=(sentinel.ema_update(state, loss, norm, delta=delta)
+                   if healthy else state))
+    return report
+
+
+def emit_rows(writer, report, step: int) -> None:
+    """Health/* rows (deterministic — they join the crash-exact byte
+    comparison, which is why the EMA state rides the round journal)."""
+    for key, tag in TAGS.items():
+        if key in report["rows"]:
+            writer.scalar(tag, float(report["rows"][key]), step)
+
+
+def enforce(cfg, report, where: str = "") -> bool:
+    """The warn/abort half of the unified policy. Non-finiteness keeps
+    its historical endpoint word-for-word (utils/guards.finite_warn —
+    including the FloatingPointError the --debug_nan contract promises);
+    the soft incidents (z-score, norm spike) warn, and abort only under
+    the abort policy. Returns the healthy bit."""
+    policy = resolve_policy(cfg)
+    finite_warn(report["finite"], where=where,
+                raise_error=policy == "abort")
+    if not report["healthy"] and report["finite"]:
+        # soft incident: its own loud line so `record` runs are greppable
+        print(f"[health] WARNING: {report['why']}"
+              f"{' at ' + where if where else ''}")
+        if policy == "abort":
+            raise HealthIncident(
+                f"health incident{' at ' + where if where else ''}: "
+                f"{report['why']}")
+    return report["healthy"]
+
+
+# --------------------------------------------------------------- the ladder
+
+
+class HealthRecovery(RuntimeError):
+    """Control-flow carrier for the rungs that rebuild the engine. The
+    service driver catches it, closes the current engine/writer and
+    re-enters serve through the crash-exact resume machinery."""
+
+    def __init__(self, rung: str, rnd: int, quarantine: str = ""):
+        super().__init__(f"health ladder: {rung} at round {rnd}")
+        self.rung = rung
+        self.rnd = rnd
+        self.quarantine = quarantine
+
+
+class HealthLadder:
+    """The per-service ladder: carried EMA baseline, per-episode rung
+    budget, cumulative counters and the quarantine list — all persisted
+    through ``health_state.json`` so a kill at ANY rung resumes the
+    ladder exactly where it stood."""
+
+    def __init__(self, cfg, state_path: Optional[str] = None):
+        from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+            run_name)
+        self.cfg = cfg
+        self.state_path = state_path
+        # in-memory (deliberately unpersisted): recovery re-entries THIS
+        # process has performed — the serve() recursion-depth bound
+        self.reentries = 0
+        # the state file lives at the log_dir root (the status.json /
+        # chaos_state.json convention, where external watchers look),
+        # so it carries the run's identity: a DIFFERENT experiment
+        # sharing the log_dir must start a fresh ladder, not inherit
+        # this one's EMA baseline, spent budgets and quarantine list.
+        # run_name deliberately ignores --quarantine, so a QUARANTINE
+        # re-entry and a kill-resume both match their own state.
+        self.run = run_name(cfg)
+        self.state = {"run": self.run, "ema": sentinel.ema_init(),
+                      "episode": {"discards": 0, "rollbacks": 0,
+                                  "quarantines": 0, "open": False},
+                      "counters": {r: 0 for r in RUNGS},
+                      "quarantined": [], "incidents": 0}
+        if state_path and os.path.exists(state_path):
+            try:
+                with open(state_path, encoding="utf-8") as f:
+                    loaded = json.load(f)
+                if loaded.get("run") == self.run:
+                    self.state.update(loaded)
+                else:
+                    print(f"[health] {state_path} belongs to run "
+                          f"{loaded.get('run')!r} — starting a fresh "
+                          f"ladder for {self.run!r}")
+            except (OSError, ValueError):
+                pass
+        # a pre-existing --quarantine (a prior QUARANTINE rung's re-entry)
+        # is part of the ladder's record
+        for cid in sentinel.quarantine_ids(cfg):
+            if cid not in self.state["quarantined"]:
+                self.state["quarantined"].append(cid)
+
+    # ------------------------------------------------------------ persistence
+
+    def _save(self) -> None:
+        if self.state_path:
+            atomic_write_text(self.state_path, json.dumps(self.state))
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        return dict(self.state["counters"])
+
+    # ------------------------------------------------------------- judgement
+
+    def check(self, cfg, eng, rnd: int, prev_params=None) -> Dict:
+        """Synchronously judge round ``rnd``'s sentinel lanes (a small
+        host fetch — the recover policy trades one tiny boundary sync
+        for the ability to act BEFORE the bad commit reaches the
+        checkpoint). ``prev_params`` (the params the round was dispatched
+        from — the driver retains them for the DISCARD rung anyway) arms
+        the committed-delta norm lane: sentinel.delta_spike catches a
+        magnitude fault in the commit itself at THIS boundary, where the
+        loss z-score would only see it at the next one, after the bad
+        params had reached a checkpoint. Returns the assess() report."""
+        info = eng._last_info
+        vals = {"finite": bool(np.asarray(
+            jax.device_get(all_finite_device(eng.model_params))))}
+        if prev_params is not None:
+            vals["hlth_delta_norm"] = delta_norm(prev_params,
+                                                 eng.model_params)
+        for key in sentinel.boundary_keys(cfg):
+            if key in info:
+                vals[key] = float(np.asarray(info[key]))
+        if "train_loss" in info:
+            vals["train_loss"] = float(np.asarray(info["train_loss"]))
+        else:
+            vals["train_loss"] = float("nan")
+        return assess(cfg, self.state["ema"], vals)
+
+    def note_healthy(self, report) -> None:
+        """A healthy boundary: fold it into the EMA baseline and close
+        any open incident episode (the rung budget resets; cumulative
+        counters and the quarantine list persist)."""
+        self.state["ema"] = report["new_state"]
+        if self.state["episode"]["open"]:
+            self.state["episode"] = {"discards": 0, "rollbacks": 0,
+                                     "quarantines": 0, "open": False}
+        self._save()
+
+    def next_rung(self, cfg, quarantine_ok: bool = True) -> str:
+        """The deterministic escalation: every rung's budget is a named
+        constant, and a rung that cannot run (no checkpoint dir to roll
+        back to, suspect budget exhausted, ``quarantine_ok=False`` on
+        the host-sampled path whose program never sees the sampled
+        client ids) is skipped — the walk always terminates at HALT."""
+        ep = self.state["episode"]
+        if ep["discards"] < MAX_DISCARDS:
+            return "discard"
+        if ep["rollbacks"] < MAX_ROLLBACKS and cfg.checkpoint_dir:
+            return "rollback"
+        # quarantine re-enters through the SAME checkpoint-restore
+        # machinery as rollback — without a checkpoint dir the re-entry
+        # would silently restart from round 0, so the rung is skipped
+        # exactly like rollback
+        if (quarantine_ok and cfg.checkpoint_dir
+                and ep["quarantines"] < 1
+                and len(self.state["quarantined"]) < MAX_QUARANTINED):
+            return "quarantine"
+        return "halt"
+
+    def record(self, rung: str, rnd: int, sup=None) -> None:
+        ep = self.state["episode"]
+        ep["open"] = True
+        if rung == "discard":
+            ep["discards"] += 1
+        elif rung == "rollback":
+            ep["rollbacks"] += 1
+        elif rung == "quarantine":
+            ep["quarantines"] += 1
+        self.state["counters"][rung] += 1
+        self.state["incidents"] += 1
+        self._save()
+        if sup is not None:
+            # a counted, journaled status.json phase per transition —
+            # recovery is observable, not inferred from silence
+            sup.phase(f"health_{rung}", health_round=rnd,
+                      **{f"health_{r}s": c
+                         for r, c in self.state["counters"].items()})
+
+    def suspects(self, eng, rnd: int) -> List[int]:
+        """The QUARANTINE rung's suspect set: the incident round's
+        sampled clients whose update was nonfinite (hlth_agent_bad,
+        single-device paths), degrading to the whole sampled cohort on
+        the sharded paths (materializing per-slot bits there would cost
+        the all_gather the zero-collective lane forbids)."""
+        info = eng._last_info
+        if "sampled" not in info:
+            return []
+        ids = np.asarray(info["sampled"]).reshape(-1)
+        if "hlth_agent_bad" in info:
+            bad = np.asarray(info["hlth_agent_bad"]).reshape(-1)
+            if bad.any():
+                ids = ids[bad.astype(bool)]
+        merged = sorted(set(self.state["quarantined"])
+                        | set(int(i) for i in ids))
+        return merged[:MAX_QUARANTINED]
+
+    def quarantine_spec(self, eng, rnd: int) -> str:
+        ids = self.suspects(eng, rnd)
+        self.state["quarantined"] = ids
+        self._save()
+        return ",".join(str(i) for i in ids)
+
+    def summary(self) -> Dict:
+        return {"incidents": self.state["incidents"],
+                **{f"health_{r}s": c
+                   for r, c in self.state["counters"].items()},
+                "quarantined": list(self.state["quarantined"])}
+
+
+def ema_init():
+    return sentinel.ema_init()
+
+
+def delta_norm(prev, params) -> float:
+    """Host-fetched l2 norm of the committed delta (params - prev) over
+    finite coordinates — the ladder's boundary-cadence magnitude lane
+    (one tiny reduction per eval boundary, recover policy only)."""
+    total = sum(
+        jnp.sum(jnp.where(jnp.isfinite(d), d, 0.0) ** 2)
+        for d in (jnp.asarray(b - a, dtype=jnp.float32)
+                  for a, b in zip(jax.tree_util.tree_leaves(prev),
+                                  jax.tree_util.tree_leaves(params))))
+    return float(np.sqrt(np.asarray(jax.device_get(total))))
+
+
+def poison_params(params):
+    """Chaos ``nan@N``: write one NaN into the first parameter leaf —
+    the deterministic stand-in for a bf16 NaN burst (service/chaos.py
+    decides WHEN; this is the how)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    idx = (0,) * leaves[0].ndim
+    leaves[0] = leaves[0].at[idx].set(jnp.nan)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def spike_params(prev, params, factor: float):
+    """Chaos ``spike@N:x``: scale the round's committed delta by x —
+    a finite magnitude burst that trips the norm-spike sentinel without
+    touching finiteness."""
+    return jax.tree_util.tree_map(
+        lambda p0, p1: p0 + factor * (p1 - p0), prev, params)

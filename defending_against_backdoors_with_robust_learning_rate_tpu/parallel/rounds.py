@@ -40,6 +40,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
     buffered)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     bind_data, make_block_trainer, make_chained)
+from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+    sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops import tree
 from defending_against_backdoors_with_robust_learning_rate_tpu.ops.aggregate import (
     RFA_EPS, RFA_ITERS, agent_sq_dists, apply_aggregate, gaussian_noise_like,
@@ -554,6 +556,26 @@ def _sharded_pallas_apply(params, updates, sizes, cfg):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def _loss_and_health(cfg, losses, updates_local, new_params, mask_local, d):
+    """The shard body's loss reduction, with the health-sentinel lanes
+    packed into the SAME collective when the lane is on
+    (health/sentinel.py): pmean's scalar psum becomes one [3] vector
+    psum — a shape change, never a new collective (the ``*_hlth``
+    CheckSpecs pin the unchanged plan at 1/8/16-way). Lane 0 is exactly
+    pmean's arithmetic (psum/d), so the loss is bitwise the health-off
+    value."""
+    if not health_sentinel.health_on(cfg):
+        return jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS), {}
+    with jax.named_scope("health"):
+        lanes = jnp.concatenate(
+            [jnp.mean(losses)[None],
+             health_sentinel.local_lanes(updates_local, mask_local)])
+        packed = jax.lax.psum(lanes, AGENTS_AXIS)
+        extras = health_sentinel.finish_sharded(packed[1], packed[2],
+                                                new_params)
+    return packed[0] / d, extras
+
+
 def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                         take_active=None, mt=False):
     """The shard_mapped round body shared by the per-round and chained fns.
@@ -596,7 +618,11 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         _pallas_applicable, host_takes_flags)
     faults_on = cfg.faults_enabled
-    churn_on = cfg.churn_enabled if take_active is None else take_active
+    # a quarantine set (health/monitor.py) rides the same replicated
+    # availability-mask input as churn — the caller composes both masks
+    # outside shard_map, so the body only sees one [m] bool channel
+    churn_on = ((cfg.churn_enabled or health_sentinel.has_quarantine(cfg))
+                if take_active is None else take_active)
     atk_on = attack_registry.in_jit(cfg)
     # tenant packs gate every in-jit attack per tenant (the trivial
     # schedule's traced gate is always-on); solo bodies only take the
@@ -734,6 +760,12 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                 if wsum_l is not None:
                     lanes.append(jnp.atleast_1d(wsum_l))
                 lanes.append(loss_local[None])
+                h_on = health_sentinel.health_on(cfg)
+                if h_on:
+                    # the health-sentinel lanes ride the SAME packed
+                    # psum (health/sentinel.py — zero added collectives)
+                    lanes.append(health_sentinel.local_lanes(updates,
+                                                             mask_local))
                 packed = jax.lax.psum(jnp.concatenate(lanes), AGENTS_AXIS)
                 n1 = lanes[0].shape[0]
                 contribs = dict(g_trees)
@@ -743,11 +775,15 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                                         else packed[1])
                 # the loss lane rides the packed psum: psum/d is exactly
                 # pmean's arithmetic, so the budget stays the sync plan's
-                loss = packed[-1] / d
+                loss = (packed[-3] if h_on else packed[-1]) / d
                 new_params, new_astate, lr, agg, a_extras, vote_sign = \
                     buffered.fold_commit(cfg, params, astate, contribs,
                                          noise_key, m)
             extras = dict(a_extras)
+            if h_on:
+                with jax.named_scope("health"):
+                    extras.update(health_sentinel.finish_sharded(
+                        packed[-2], packed[-1], new_params))
             if faults_on:
                 extras.update(fmodel.fault_scalars(draw, mask_full))
                 if churn_full is not None and cfg.churn_enabled:
@@ -768,8 +804,9 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
             return (new_params, new_astate), loss, extras
         if _pallas_applicable(cfg):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
-            loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
-            return new_params, loss, {}
+            loss, hextras = _loss_and_health(cfg, losses, updates,
+                                             new_params, None, d)
+            return new_params, loss, hextras
         sign_sums = None
         bucket_info = None
         with jax.named_scope("aggregate_rlr"):
@@ -800,8 +837,8 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                 agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
                                          mask_local, mask_full)
                 new_params = apply_aggregate(params, lr, agg)
-        loss = jax.lax.pmean(jnp.mean(losses), AGENTS_AXIS)
-        extras = {}
+        loss, extras = _loss_and_health(cfg, losses, updates, new_params,
+                                        mask_local, d)
         if faults_on:
             extras.update(fmodel.fault_scalars(draw, mask_full))
             if churn_full is not None and cfg.churn_enabled:
@@ -860,6 +897,11 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
         extras_specs["agent_norms"] = P()
         if cfg.robustLR_threshold > 0:
             extras_specs["lr_flat"] = P()
+    # health-sentinel scalars (health/sentinel.py): replicated outputs
+    # (the psummed lanes + the params-finite bit); the sharded key set
+    # excludes the [m] suspect vector by construction
+    extras_specs.update({k: P() for k in
+                         health_sentinel.health_keys(cfg, sharded=True)})
 
     if mt:
         # tenant axis INSIDE the shard: every input grows a leading [E]
@@ -914,13 +956,21 @@ def _make_sample_step(cfg, model, normalize, mesh):
             szs = jnp.take(sizes, sampled, axis=0)
         agent_keys = jax.random.split(k_train, m)
         extra = ((sampled < cfg.num_corrupt,) if want_flags else ())
+        active = None
         if cfg.churn_enabled:
             from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
                 churn as churn_mod)
             # lifecycle draw computed OUTSIDE shard_map (it needs the
             # sampled ids + round index); enters the body replicated
             with jax.named_scope("churn_mask"):
-                extra = extra + (churn_mod.active_slots(cfg, sampled, rnd),)
+                active = churn_mod.active_slots(cfg, sampled, rnd)
+        if health_sentinel.has_quarantine(cfg):
+            # quarantine membership composes into the same replicated
+            # availability input (health/monitor.py QUARANTINE rung)
+            qmask = health_sentinel.quarantine_mask(cfg, sampled)
+            active = qmask if active is None else active & qmask
+        if active is not None:
+            extra = extra + (active,)
         if attack_registry.needs_round(cfg):
             # schedule gate computed OUTSIDE shard_map from the round
             # index; enters the body as a replicated scalar
@@ -995,13 +1045,21 @@ def make_sharded_round_fn_mt(cfg, model, normalize, mesh,
         extra = ()
         if want_flags:
             extra += (sampled_E < cfg.num_corrupt,)
+        active_E = None
         if cfg.churn_enabled:
             from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
                 churn as churn_mod)
             with jax.named_scope("churn_mask"):
-                extra += (jax.vmap(
+                active_E = jax.vmap(
                     lambda s: churn_mod.active_slots(cfg, s, rnd))(
-                        sampled_E),)
+                        sampled_E)
+        if health_sentinel.has_quarantine(cfg):
+            q_E = jax.vmap(
+                lambda s: health_sentinel.quarantine_mask(cfg, s))(
+                    sampled_E)
+            active_E = q_E if active_E is None else active_E & q_E
+        if active_E is not None:
+            extra += (active_E,)
         if atk_gated:
             # per-tenant schedule gates from the traced knob triples —
             # replicated [E] input, zero collectives (the solo gate idiom)
@@ -1134,6 +1192,11 @@ def make_sharded_cohort_step(cfg, model, normalize, mesh):
     def step(params, key, rnd, imgs, lbls, szs):
         with jax.named_scope("cohort_sample"):
             ids, active = cohort_mod.sample_cohort(cfg, rnd)
+        if health_sentinel.has_quarantine(cfg):
+            # quarantined members leave through the active mask, the
+            # shortfall-padding / churn-absence protocol (fl/rounds
+            # make_cohort_step does the same on the single-device path)
+            active = active & health_sentinel.quarantine_mask(cfg, ids)
         k_train, k_noise = jax.random.split(key)
         agent_keys = jax.random.split(k_train, m)
         extra = (((ids < cfg.num_corrupt) & active,) if want_flags else ())

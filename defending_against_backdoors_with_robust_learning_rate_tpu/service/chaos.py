@@ -37,6 +37,23 @@ retry) and/or ``:arg`` (seconds for the slow/wedge actions)::
     corrupt_ckpt@4         round 4's just-saved checkpoint gets its bytes
                            flipped on disk (digest-verified restore must
                            fall back to the previous one)
+    nan@5                  one NaN written into the committed params right
+    nan@5x2                after round 5's dispatch — the deterministic
+                           stand-in for a bf16 NaN burst; x2 also poisons
+                           the health ladder's DISCARD re-dispatch, so
+                           recovery must escalate to ROLLBACK (ISSUE 14)
+    spike@3:25             round 3's committed delta scaled x25 — a finite
+                           magnitude burst tripping the norm-spike
+                           sentinel (health/sentinel.py)
+    bank_corrupt@0         flip bytes in the client bank's 0th
+                           indices-*.bin shard BEFORE the engine opens it
+                           (--bank_verify must fail loudly naming the
+                           shard)
+    kill_recover@4         SIGKILL in the window where the health ladder
+                           has RECORDED a rollback/quarantine for round 4
+                           but its crash-exact re-entry has not finished —
+                           the resumed process must resume the LADDER
+                           (health_state.json), not the failure
 
 Injections persist their fire counts in a small state file (atomic
 rewrite) so a ``kill`` does NOT re-fire after the resumed process replays
@@ -59,7 +76,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.utils.checkpoint 
     atomic_write_text)
 
 ACTIONS = ("kill", "kill_midbuf", "wedge", "poison", "poison_eval",
-           "slow_eval", "wedge_drain", "corrupt_ckpt")
+           "slow_eval", "wedge_drain", "corrupt_ckpt",
+           "nan", "spike", "bank_corrupt", "kill_recover")
 
 _TERM_RE = re.compile(
     r"^(?P<action>[a-z_]+)@(?P<round>\d+)"
@@ -166,6 +184,87 @@ class Chaos:
     def requires_buffered(self) -> bool:
         """Whether the spec contains a buffered-mode-only drill."""
         return any(inj.action == "kill_midbuf" for inj in self.injections)
+
+    def nan_due(self, rnd: int) -> bool:
+        """Numerics drill (ISSUE 14): whether a NaN should be written
+        into round ``rnd``'s committed params (the driver performs the
+        write — health/monitor.poison_params). Fire counts persist, so
+        the health ladder's DISCARD re-dispatch only re-meets the fault
+        when the spec says xN > 1, and a post-ROLLBACK replay of an
+        exhausted injection runs clean — recovery is observable as a
+        healthy replay, exactly like the kill drills."""
+        inj = self._due("nan", rnd)
+        if inj is None:
+            return False
+        self._mark(inj)
+        print(f"[chaos] NaN written into round {rnd}'s params "
+              f"({inj.key})", flush=True)
+        return True
+
+    def spike_due(self, rnd: int) -> float:
+        """Numerics drill: the factor round ``rnd``'s committed delta
+        should be scaled by (0.0 = no injection; default x20 trips the
+        default --health_spike_factor of 10 with margin)."""
+        inj = self._due("spike", rnd)
+        if inj is None:
+            return 0.0
+        self._mark(inj)
+        factor = inj.arg or 20.0
+        print(f"[chaos] round {rnd}'s update scaled x{factor:g} "
+              f"({inj.key})", flush=True)
+        return factor
+
+    def corrupt_bank(self, bank_root: str, dataset: str = "") -> bool:
+        """Data-plane drill: flip bytes mid-file in the N-th
+        ``indices-*.bin`` shard found under ``bank_root`` (N = the
+        term's @round slot, reused as a shard index). ``dataset`` scopes
+        the walk to bank subdirectories named ``<dataset>-<key>`` (the
+        data/registry layout) — a shared persistent client_banks root
+        can hold OTHER experiments' banks, and a drill must never
+        damage data the drilled run will not even open. Runs BEFORE the
+        engine opens the bank, so a --bank_verify open must detect it
+        and name the shard. Returns True when anything fired."""
+        fired = False
+        for inj in self.injections:
+            if (inj.action != "bank_corrupt"
+                    or self._fired.get(inj.key, 0) >= inj.count):
+                continue
+            shards = sorted(
+                os.path.join(base, name)
+                for base, _dirs, files in os.walk(bank_root)
+                for name in files
+                if name.startswith("indices-") and name.endswith(".bin")
+                and (not dataset or os.path.abspath(base) ==
+                     os.path.abspath(bank_root)
+                     or os.path.basename(base).startswith(f"{dataset}-")))
+            if not shards:
+                continue
+            victim = shards[inj.rnd % len(shards)]
+            size = os.path.getsize(victim)
+            with open(victim, "r+b") as f:
+                f.seek(max(0, size // 2))
+                f.write(b"\xde\xad\xbe\xef")
+            self._mark(inj)
+            print(f"[chaos] corrupted bank shard {victim} ({inj.key})",
+                  flush=True)
+            fired = True
+        return fired
+
+    def maybe_kill_recover(self, rnd: int) -> None:
+        """Kill-mid-rollback drill (ISSUE 14): SIGKILL in the window the
+        health ladder just RECORDED a rollback/quarantine for round
+        ``rnd`` (state saved, engine closed) but the crash-exact
+        re-entry has not completed — the one crash window the recovery
+        ladder adds. The resumed process must pick the ladder up from
+        health_state.json, not re-meet the original failure. Marks state
+        first, like every kill."""
+        inj = self._due("kill_recover", rnd)
+        if inj is None:
+            return
+        self._mark(inj)
+        print(f"[chaos] kill -9 mid-recovery of round {rnd} "
+              f"({inj.key})", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
 
     def on_eval(self, rnd: int) -> None:
         inj = self._due("slow_eval", rnd)

@@ -42,16 +42,18 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
     Config, args_parser)
+from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+    monitor as health_monitor)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
     chaos as chaos_mod, churn as churn_mod)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service.supervisor import (
-    Supervisor, UnitFailure, WEDGED)
+    POISONED, Supervisor, UnitFailure, WEDGED)
 from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
     RoundEngine)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -133,7 +135,8 @@ def prepare_crash_exact_resume(cfg: Config, truncate: bool = True) -> Dict:
 
 def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
           max_rounds: Optional[int] = None, _adapt=None,
-          _adapt_reentry: bool = False) -> Dict:
+          _adapt_reentry: bool = False, _health=None,
+          _phases: Optional[List[str]] = None) -> Dict:
     """Run the continuous service; returns the engine summary extended
     with a ``service`` section (retry/degradation counters, recovery
     info).
@@ -189,6 +192,46 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                 "with --agg_mode buffered, or use the plain kill@N")
         print(f"[service] chaos injections armed: {cfg.chaos}")
 
+    if chaos.active:
+        # data-plane drill (ISSUE 14): a bank_corrupt term fires BEFORE
+        # the engine opens the bank, so verify-on-open meets the damage
+        # — searching the SAME root the engine will resolve
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data.registry import (
+            resolve_bank_root)
+        chaos.corrupt_bank(resolve_bank_root(cfg), dataset=cfg.data)
+
+    ladder = _health
+    if health_monitor.resolve_policy(cfg) == "recover" \
+            and cfg.rlr_adapt == "on":
+        # an adapted segment's live metrics stream sits at the ORIGINAL
+        # threshold's run_name (the _adapt_reentry comment above); a
+        # ladder re-entry inside that segment would crash-exact-splice a
+        # phantom path computed from the ADAPTED cfg, stranding the real
+        # stream. Refuse the combination until the re-entry threads the
+        # stream's run dir explicitly.
+        raise ValueError(
+            "--health_policy recover is not supported together with "
+            "--rlr_adapt on (the ladder's rollback re-entry would "
+            "splice the wrong metrics stream inside an adapted "
+            "segment); run with --health_policy record, or without "
+            "adaptation")
+    if health_monitor.resolve_policy(cfg) == "recover" and ladder is None:
+        ladder = health_monitor.HealthLadder(
+            cfg, state_path=os.path.join(cfg.log_dir,
+                                         health_monitor.STATE_NAME))
+        print("[health] auto-recovery ladder armed (--health_policy "
+              "recover): discard -> rollback -> quarantine -> halt; "
+              f"state in {ladder.state_path}")
+        # a kill AFTER a QUARANTINE rung was recorded but BEFORE its
+        # re-entry completed leaves the suspect set only in the state
+        # file — re-arm it, or the resumed process would serve with the
+        # suspects still voting (the ladder resumes, not the failure)
+        spec = ",".join(str(i) for i in ladder.state["quarantined"])
+        if spec and spec != cfg.quarantine:
+            print(f"[health] re-arming journaled quarantine set "
+                  f"[{spec}] from {ladder.state_path}")
+            cfg = cfg.replace(quarantine=spec)
+
     adapt = _adapt
     if cfg.rlr_adapt == "on" and adapt is None:
         from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
@@ -203,6 +246,11 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
     sup = Supervisor(retries=cfg.service_retries,
                      backoff_s=cfg.service_backoff_s,
                      deadline_s=cfg.service_deadline_s, hb=eng.hb)
+    if _phases:
+        # in-process re-entry (health ladder / adaptation): the phase
+        # history is one continuous record — status.json must still show
+        # the health_rollback that CAUSED this re-entry
+        sup.phases_seen.extend(_phases)
     if recovery["resumed_from"] and eng.start_round:
         sup.phase("recover", recovered_round=eng.start_round)
         print(f"[service] recovered at round {eng.start_round} "
@@ -234,21 +282,34 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
     eng.set_schedule(unit_stream())
     evals_skipped = 0
     adapt_to = None   # (new_threshold, boundary_round) when a move fires
+    recover_to = None  # a ladder rung that rebuilds the engine fired
     try:
         for unit in unit_stream():
             rnd = unit[0]
+            # retained for the ladder's DISCARD rung (a reference, not a
+            # copy — per-round families deliberately do not donate) and
+            # the spike chaos injector's delta
+            prev_params = eng.params
 
             def do_dispatch(unit=unit, rnd=rnd):
                 chaos.on_dispatch(rnd)
                 eng.dispatch(unit)
 
             sup.run("dispatch", do_dispatch, unit=rnd)
+            _numerics_chaos(chaos, eng, rnd, prev_params)
             # kill-mid-round drill: after dispatch, before the boundary's
             # eval/checkpoint — the rows for this round must be replayed
             # bit-identically by the resumed process
             chaos.maybe_kill(rnd)
 
             if rnd % cfg.snap == 0:
+                if ladder is not None:
+                    # the recovery ladder judges the round's sentinel
+                    # lanes BEFORE the boundary's eval/checkpoint: a bad
+                    # commit must never reach the checkpoint, and a
+                    # DISCARD heals in place before any row is emitted
+                    _run_ladder(cfg, eng, sup, ladder, chaos, rnd, unit,
+                                prev_params)
                 def do_eval(rnd=rnd):
                     chaos.on_eval(rnd)
                     eng.eval_boundary(rnd)
@@ -332,6 +393,11 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         if eng.drain is not None:
             eng.hb.update(phase="drain", force=True)
             eng.drain.flush()
+    except health_monitor.HealthRecovery as hr:
+        # ROLLBACK / QUARANTINE: tear this engine down and re-enter
+        # through the crash-exact resume machinery below (the finally
+        # still closes the engine first)
+        recover_to = hr
     except UnitFailure:
         # poisoned/give-up on a non-degradable unit: exit loudly, journal
         # intact — the next `serve` resumes crash-exactly
@@ -340,6 +406,60 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         raise
     finally:
         eng.close()
+    if recover_to is not None:
+        eng.hb.update(phase=f"health_{recover_to.rung}", force=True,
+                      health_round=recover_to.rnd)
+        # kill-mid-rollback drill window: the rung is recorded (ladder
+        # state saved) and the engine is closed, but recovery has not
+        # completed — a kill HERE must resume the ladder, not the failure
+        chaos.maybe_kill_recover(recover_to.rnd)
+        print(f"[health] {recover_to.rung.upper()} at round "
+              f"{recover_to.rnd}: re-entering through the crash-exact "
+              f"resume (newest digest-valid checkpoint + metrics splice)"
+              + (f"; quarantining clients [{recover_to.quarantine}]"
+                 if recover_to.quarantine else ""))
+        writer.close()
+        # each recovery re-enters serve() recursively: bound the depth
+        # per PROCESS so a long-lived service surviving many healed
+        # episodes cannot creep toward the interpreter's recursion
+        # limit — the crash-exact machinery makes a process restart
+        # free, so the bound trades nothing away (the ladder state file
+        # carries everything across it)
+        ladder.reentries += 1
+        if ladder.reentries > health_monitor.MAX_REENTRIES_PER_PROCESS:
+            raise UnitFailure(
+                "health", recover_to.rnd, POISONED, ladder.reentries,
+                health_monitor.HealthIncident(
+                    f"{ladder.reentries} recovery re-entries in one "
+                    f"process (> "
+                    f"{health_monitor.MAX_REENTRIES_PER_PROCESS}); "
+                    f"restart the service — it resumes crash-exactly "
+                    f"with the ladder state intact"))
+        new_cfg = (cfg.replace(quarantine=recover_to.quarantine)
+                   if recover_to.quarantine else cfg)
+        outer_wall = time.perf_counter() - t_start
+        # writer=None: the re-entry must reopen the stream AFTER the
+        # crash-exact truncate (run_name deliberately ignores
+        # --quarantine, so the stream path is unchanged)
+        sub = serve(new_cfg, writer=None, max_rounds=total, _adapt=adapt,
+                    _health=ladder, _phases=sup.phases_seen)
+        svc = sub.setdefault("service", {})
+        # rounds_served counts DISTINCT rounds: the inner serve resumed
+        # from a checkpoint BEHIND this segment's last round and
+        # re-serves the overlap, so this segment only contributes the
+        # prefix the inner did not replay (unlike the adapt re-entry
+        # below, which resumes exactly at the boundary — no overlap)
+        distinct = max(0, int(svc.get("resumed_from", 0))
+                       - eng.start_round)
+        for key, extra in ({**sup.counters,
+                            "evals_skipped": evals_skipped,
+                            "rounds_served": distinct,
+                            "wall_s": outer_wall}).items():
+            svc[key] = round(svc.get(key, 0) + extra, 3)
+        svc["phases_seen"] = sorted(set(svc.get("phases_seen", []))
+                                    | set(sup.phases_seen))
+        svc["health"] = ladder.summary()
+        return sub
     if adapt_to is not None:
         new_thr, at_rnd = adapt_to
         old_thr = cfg.robustLR_threshold
@@ -355,7 +475,8 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         outer_wall = time.perf_counter() - t_start
         sub = serve(cfg.replace(robustLR_threshold=new_thr),
                     writer=writer, max_rounds=total, _adapt=adapt,
-                    _adapt_reentry=True)
+                    _adapt_reentry=True, _health=ladder,
+                    _phases=sup.phases_seen)
         # the reliability record must cover the WHOLE run, not just the
         # last segment: fold this segment's supervisor counters into the
         # inner serve's service section
@@ -387,11 +508,85 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         "rounds_served": eng.rounds_done,
         "wall_s": round(time.perf_counter() - t_start, 3),
     }
+    if ladder is not None:
+        summary["service"]["health"] = ladder.summary()
     print(f"[service] served {eng.rounds_done} round(s); "
           f"retries={sup.counters['retries']} "
           f"evals_skipped={evals_skipped} "
           f"resumed_from={recovery['resumed_from']}")
     return summary
+
+
+def _numerics_chaos(chaos, eng, rnd: int, prev_params) -> None:
+    """Apply the numerics chaos injections (nan@N / spike@N:x) to the
+    round's committed params. In buffered mode only the MODEL half of
+    the (params, buffer) carry is touched — the buffer holds integer
+    counters whose dtype a float transform would silently change."""
+    if not chaos.active:
+        return
+    if chaos.nan_due(rnd):
+        if eng.async_mode:
+            eng.params = (health_monitor.poison_params(eng.params[0]),
+                          eng.params[1])
+        else:
+            eng.params = health_monitor.poison_params(eng.params)
+    factor = chaos.spike_due(rnd)
+    if factor:
+        if eng.async_mode:
+            eng.params = (health_monitor.spike_params(
+                prev_params[0], eng.params[0], factor), eng.params[1])
+        else:
+            eng.params = health_monitor.spike_params(prev_params,
+                                                     eng.params, factor)
+
+
+def _run_ladder(cfg, eng, sup, ladder, chaos, rnd: int, unit,
+                prev_params) -> None:
+    """One boundary's walk of the auto-recovery ladder
+    (health/monitor.py). Healthy: fold the boundary into the EMA
+    baseline and return. Incident: DISCARD in place (withdraw the
+    commit, re-dispatch with a recovery nonce — a persistent fault, like
+    a chaos nan@NxK with fire budget left, re-poisons the replay and
+    escalates), then ROLLBACK / QUARANTINE via HealthRecovery (serve
+    re-enters through the crash-exact machinery), then HALT loudly."""
+    model_prev = prev_params[0] if eng.async_mode else prev_params
+    report = ladder.check(cfg, eng, rnd, prev_params=model_prev)
+    while not report["healthy"]:
+        # the QUARANTINE rung feeds --quarantine, which the host-sampled
+        # program refuses (it never sees the sampled client ids) — that
+        # path escalates past it. DISCARD is safe everywhere: the
+        # prefetcher retains the last-served payload precisely for
+        # same-unit re-dispatch (data/prefetch.RoundPrefetcher.get).
+        rung = ladder.next_rung(cfg, quarantine_ok=not eng.host_mode)
+        ladder.record(rung, rnd, sup)
+        print(f"[health] incident at round {rnd} ({report['why']}) "
+              f"-> {rung.upper()}")
+        if rung == "discard":
+            eng.params = prev_params
+            eng.rounds_done -= 1
+            eng.dispatch(unit, nonce=ladder.state["episode"]["discards"])
+            _numerics_chaos(chaos, eng, rnd, prev_params)
+            report = ladder.check(cfg, eng, rnd,
+                                  prev_params=model_prev)
+            continue
+        if rung == "rollback":
+            raise health_monitor.HealthRecovery("rollback", rnd)
+        if rung == "quarantine":
+            spec = ladder.quarantine_spec(eng, rnd)
+            if spec:
+                raise health_monitor.HealthRecovery("quarantine", rnd,
+                                                    quarantine=spec)
+            # no suspect evidence at all: nothing to quarantine — the
+            # episode budget is spent either way, so fall through
+            report = ladder.check(cfg, eng, rnd,
+                                  prev_params=model_prev)
+            continue
+        raise UnitFailure(
+            "health", rnd, POISONED, ladder.state["incidents"],
+            health_monitor.HealthIncident(
+                f"health ladder exhausted at round {rnd}: "
+                f"{report['why']}"))
+    ladder.note_healthy(report)
 
 
 def _emit_service_rows(eng, sup: Supervisor, evals_skipped: int,

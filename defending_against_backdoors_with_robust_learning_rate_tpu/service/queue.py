@@ -56,7 +56,13 @@ SUMMARY_KEYS = ("round", "val_acc", "val_loss", "poison_acc", "poison_loss",
                 # (obs/telemetry.host_summary via train.py): the
                 # scenario matrix (scripts/sweep_scenarios.py) records
                 # defense state per cell, not just outcomes
-                "defense")
+                "defense",
+                # the last boundary's Health/* snapshot (health/monitor
+                # via train.py / service/tenancy.py): a sweep cell that
+                # went nonfinite under --health_policy record is a
+                # RECORDED verdict in the queue results, never a dead
+                # queue or a silent hole
+                "health")
 
 
 def load_cells(path: str) -> List[Dict[str, Any]]:
@@ -227,7 +233,11 @@ def _queue_summary_row(rows: List[Dict[str, Any]],
         "packed_cells": packed, "serial_cells": len(ok) - packed,
         "wall_s": round(wall_s, 3),
         "cells_per_hour": round(3600.0 * len(ok) / max(wall_s, 1e-9), 2),
-        "steady_s": round(steady_s, 3),
+        # clamped: steady_s is assembled from per-cell wall_s values that
+        # were ROUNDED at emit time, so on a fully-warm queue their sum
+        # can exceed the true wall by sub-ms rounding — the invariant
+        # wall_s >= steady_s must survive the double rounding
+        "steady_s": round(min(steady_s, wall_s), 3),
         "compile_warmup_s": round(warmup_s, 3),
     }
 

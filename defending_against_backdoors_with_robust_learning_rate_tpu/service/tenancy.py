@@ -40,6 +40,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
     tenancy as ftenancy)
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     FAULT_INFO_KEYS)
+from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+    monitor as health_monitor, sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     attribution as obs_attribution, telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -267,9 +269,17 @@ def run_pack(cfgs, names: Optional[List[str]] = None
     drain = (MetricsDrain() if rep.async_metrics else None)
     # per-tenant tel_* filter: series this tenant's SOLO twin would emit
     tel_allowed = [obs_telemetry.telemetry_keys(c) for c in cfgs]
+    # scalar health lanes only — the solo twin's boundary_keys
+    # discipline: the [E, m] hlth_agent_bad suspect vector is ladder
+    # evidence and must never ride the per-boundary device->host fetch
+    hlth_boundary = set(health_sentinel.boundary_keys(cfgs[0]))
     state = {"cum_poison": [0.0] * E, "summaries": [{} for _ in range(E)],
              "t_steady": None, "r_steady": 0,
-             "t_steady_end": None, "r_steady_end": 0}
+             "t_steady_end": None, "r_steady_end": 0,
+             # per-tenant health-EMA baselines (health/sentinel.py): each
+             # tenant's Health/Loss_Z judges against ITS OWN history,
+             # exactly like its solo twin
+             "health_ema": [None] * E}
     fold = jax.jit(jax.vmap(jax.random.fold_in, in_axes=(0, None)))
 
     def emit(vals, ernd, rounds_done_now, elapsed):
@@ -277,10 +287,33 @@ def run_pack(cfgs, names: Optional[List[str]] = None
         thread (async) or inline (sync); mirrors the solo
         train._emit_eval_body row order so tenant streams byte-compare
         to solo runs modulo wall-clock rows."""
-        finite_warn(vals["finite"], where=f"pack round {ernd}")
+        lane_on = "hlth_nonfinite" in vals
+        if not lane_on:
+            # --health off keeps the historical pack-level endpoint
+            finite_warn(vals["finite"], where=f"pack round {ernd}")
         now = time.perf_counter()
         for e, (writer, cfg) in enumerate(zip(writers, cfgs,
                                               strict=True)):
+            report = None
+            if lane_on:
+                # per-tenant health lane: the solo twin's assess/emit/
+                # enforce (train._emit_eval_body) sliced per tenant —
+                # Health/* rows land BEFORE Validation/*, the solo row
+                # order, so tenant streams keep byte-parity with solo
+                # runs. Each tenant is judged on ITS OWN committed-params
+                # bit, not the pack-wide one (one diverging tenant must
+                # not flag its pack-mates).
+                hvals = {"finite":
+                         float(vals["hlth_params_finite"][e]) >= 1.0,
+                         "train_loss": float(vals["train_loss"][e])}
+                for k in health_sentinel.boundary_keys(cfg):
+                    if k in vals:
+                        hvals[k] = float(vals[k][e])
+                report = health_monitor.assess(
+                    cfg, state["health_ema"][e], hvals)
+                health_monitor.emit_rows(writer, report, ernd)
+                health_monitor.enforce(
+                    cfg, report, where=f"pack round {ernd} tenant {e}")
             val_loss = float(vals["val_loss"][e])
             val_acc = float(vals["val_acc"][e])
             poison_loss = float(vals["poison_loss"][e])
@@ -322,6 +355,16 @@ def run_pack(cfgs, names: Optional[List[str]] = None
                 "rounds_per_sec": rounds_done_now / elapsed}
             if tel:
                 summary["defense"] = obs_telemetry.host_summary(tel)
+            if report is not None and report["rows"]:
+                # the lane's verdict as data: queue rows
+                # (service/queue.SUMMARY_KEYS) record per-cell health —
+                # the SAME schema as the solo path's summary (train.py
+                # _emit_eval_body), so packed-vs-serial rows stay
+                # structurally identical
+                summary["health"] = {k: float(v)
+                                     for k, v in report["rows"].items()}
+                # EMA commits LAST (the solo twin's discipline)
+                state["health_ema"][e] = report["new_state"]
             state["summaries"][e] = summary
             writer.flush()
         if state["t_steady"] is None:
@@ -366,7 +409,8 @@ def run_pack(cfgs, names: Optional[List[str]] = None
                 if "churn_away" in info:
                     vals["churn_away"] = info["churn_away"]
                 vals.update({k: info[k] for k in info
-                             if k.startswith("tel_")})
+                             if k.startswith("tel_")
+                             or k in hlth_boundary})
                 elapsed = time.perf_counter() - t_loop
                 if drain is not None:
                     drain.submit(emit, vals, rnd, rounds_done, elapsed)

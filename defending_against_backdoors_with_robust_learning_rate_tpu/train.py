@@ -43,6 +43,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
     CHAINED_INFO_KEYS, FAULT_INFO_KEYS, host_takes_flags, make_round_fn,
     make_round_fn_host, step_takes_round)
+from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+    monitor as health_monitor, sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
     telemetry as obs_telemetry)
@@ -51,7 +53,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry i
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
     checkpoint as ckpt, compile_cache)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
-    all_finite_device, finite_warn, guard_round_fn)
+    all_finite_device, guard_round_fn)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
     MetricsDrain, MetricsWriter, NullWriter, run_name)
 
@@ -199,6 +201,13 @@ class RoundEngine:
                   "client-segmented loss/mask reductions (fl/client.py; "
                   "--train_layout vmap restores the per-client layout)")
         obs_telemetry.check_level(cfg.telemetry)
+        # health-lane + policy validation (health/monitor.py), loudly
+        # and before any build
+        health_monitor.check(cfg)
+        if health_sentinel.has_quarantine(cfg):
+            print(f"[health] quarantined clients: "
+                  f"{list(health_sentinel.quarantine_ids(cfg))} "
+                  f"(excluded via the participation mask)")
         # attack-config validation, loudly and before any build
         # (attack/registry.py: unknown strategy, bad boost, schedule on a
         # data-side strategy)
@@ -771,6 +780,7 @@ class RoundEngine:
         base_key = jax.random.PRNGKey(cfg.seed)
 
         start_round, cum_poison_acc, self.cum_net_mov = 0, 0.0, 0.0
+        health_ema = None
         if cfg.resume and cfg.checkpoint_dir:
             restored = ckpt.restore(
                 cfg.checkpoint_dir, params, upto=self._resume_upto,
@@ -784,6 +794,13 @@ class RoundEngine:
                     params = multihost.put_replicated(mesh, params)
                 else:
                     params = jax.device_put(params)
+                # the health-EMA baseline rides the round journal
+                # (save_checkpoint writes it): restoring it is what keeps
+                # replayed Health/Loss_Z rows byte-identical across a
+                # crash-exact resume
+                for entry in ckpt.journal_read(cfg.checkpoint_dir):
+                    if entry["round"] == start_round:
+                        health_ema = entry.get("health") or None
                 print(f"[ckpt] resumed from round {start_round}")
 
         # --- AOT adoption: swap jitted program families for banked
@@ -927,7 +944,11 @@ class RoundEngine:
         # the dispatch timestamps would measure queueing, not compute
         self.mstate = {"cum_poison_acc": cum_poison_acc, "summary": {},
                        "t_steady": None, "r_steady": 0,
-                       "t_steady_end": None, "r_steady_end": 0}
+                       "t_steady_end": None, "r_steady_end": 0,
+                       # health-EMA baseline (health/sentinel.py):
+                       # journal-restored on resume so replayed Health/*
+                       # rows are byte-identical
+                       "health_ema": health_ema}
 
         # engine state the step methods advance
         self.params = params
@@ -995,10 +1016,17 @@ class RoundEngine:
         return ((jnp.int32(rnd),)
                 if step_takes_round(self.cfg) else ())
 
-    def dispatch(self, unit) -> None:
+    def dispatch(self, unit, nonce: int = 0) -> None:
         """Run one dispatch unit (a single round or a chained block):
         advances params/rnd/rounds_done, records spans/heartbeat, feeds
-        the profiler, and emits the snap-round diagnostics scalars."""
+        the profiler, and emits the snap-round diagnostics scalars.
+
+        ``nonce`` (health/monitor.py DISCARD rung) folds a recovery
+        nonce into the single-round key so a withdrawn round re-draws
+        its stochastic choices deterministically; 0 (every normal
+        dispatch) keeps the historical derivation bit-for-bit. Chained
+        blocks never take a nonce (the service driver, the only ladder
+        host, dispatches unchained)."""
         cfg, tracer = self.cfg, self.tracer
         self.hb.update(phase="train", round=unit[-1])
         if self.prof is not None and not self.first_unit:
@@ -1028,12 +1056,15 @@ class RoundEngine:
             info.update({k: stacked[k][-1] for k in CHAINED_INFO_KEYS
                          if k in stacked})
             info.update({k: stacked[k][-1] for k in stacked
-                         if k.startswith("tel_")})
+                         if k.startswith(("tel_", "hlth_"))})
             self._want_diag, self._prev_params = False, None
         else:
             rnd = unit[0]
             with tracer.span("round/data_prep", round=rnd):
                 key = jax.random.fold_in(self.base_key, rnd)
+                if nonce:
+                    key = jax.random.fold_in(
+                        key, health_monitor.RECOVERY_NONCE + nonce)
                 snap_round = rnd % cfg.snap == 0
                 self._want_diag = cfg.diagnostics and snap_round
                 self._prev_params = self.params if self._want_diag else None
@@ -1157,6 +1188,12 @@ class RoundEngine:
                          for k in buffered_mod.ASYNC_INFO_KEYS})
         # in-jit defense telemetry rides the same (async) fetch
         vals.update({k: info[k] for k in info if k.startswith("tel_")})
+        # health-sentinel scalars (health/sentinel.py): the [m] suspect
+        # vector stays in the info dict — it is ladder evidence
+        # (service/driver.py), not a metrics row
+        vals.update({k: info[k]
+                     for k in health_sentinel.boundary_keys(cfg)
+                     if k in info})
         if self.drain is not None:
             elapsed = time.perf_counter() - self.t_loop
             self.drain.submit(self._emit_eval, vals, rnd, self.rounds_done,
@@ -1187,8 +1224,17 @@ class RoundEngine:
         # from their solo twins (the tenancy parity tests pin the
         # series they exercise, not future ones)
         cfg, writer, mstate = self.cfg, self.writer, self.mstate
-        finite_warn(vals["finite"], where=f"round {ernd}",
-                    raise_error=cfg.debug_nan)
+        # unified divergence policy (health/monitor.py): the historical
+        # finite_warn / --debug_nan endpoints AND the sentinel-lane
+        # judgement (z-score, norm spike) route through ONE assessment;
+        # `abort` raises here, `record`/`recover` warn and keep the
+        # metrics flowing. The EMA state commits LAST (with
+        # cum_poison_acc): a supervised retry of this body must not
+        # double-fold the baseline.
+        health_report = health_monitor.assess(cfg, mstate["health_ema"],
+                                              vals)
+        health_monitor.emit_rows(writer, health_report, ernd)
+        health_monitor.enforce(cfg, health_report, where=f"round {ernd}")
         val_loss = float(vals["val_loss"])
         val_acc = float(vals["val_acc"])
         poison_loss = float(vals["poison_loss"])
@@ -1248,6 +1294,15 @@ class RoundEngine:
             "round": ernd, "val_loss": val_loss, "val_acc": val_acc,
             "poison_loss": poison_loss, "poison_acc": poison_acc,
             "rounds_per_sec": rounds_done_now / elapsed}
+        if health_report["rows"]:
+            # the lane's verdict as data: queue rows read it from the
+            # run summary (service/queue.SUMMARY_KEYS "health"); the
+            # service LADDER deliberately does not — it judges the raw
+            # sentinel lanes synchronously from eng._last_info
+            # (health/monitor.HealthLadder.check), ahead of this
+            # (possibly async-drained) emit
+            mstate["summary"]["health"] = {
+                k: float(v) for k, v in health_report["rows"].items()}
         tel = obs_telemetry.host_summary(vals)
         if tel:
             # the mechanism's state as data: the scenario-matrix rows
@@ -1274,6 +1329,7 @@ class RoundEngine:
             mstate["r_steady_end"] = rounds_done_now
         writer.flush()
         mstate["cum_poison_acc"] = cum_poison_acc   # commit LAST (see top)
+        mstate["health_ema"] = health_report["new_state"]
 
     def drain_flush(self, timeout: Optional[float] = None) -> None:
         """Surface queued metrics (and any drain-thread error) now."""
@@ -1306,8 +1362,12 @@ class RoundEngine:
         if journal:
             offset = getattr(self.writer, "offset", None)
             if offset is not None:
+                # the health-EMA baseline rides the journal entry: a
+                # crash-exact resume restores it alongside the metrics
+                # splice so replayed Health/* rows are byte-identical
                 ckpt.journal_record(cfg.checkpoint_dir, rnd, offset(),
-                                    keep_last=keep)
+                                    keep_last=keep,
+                                    health=self.mstate["health_ema"])
 
     def post_unit(self) -> None:
         """End-of-unit bookkeeping: flip the compile flag after the first

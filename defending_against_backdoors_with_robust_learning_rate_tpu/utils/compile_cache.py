@@ -108,6 +108,12 @@ EXCLUDED_FIELDS = frozenset({
     # fingerprinted)
     "service_rounds", "service_retries", "service_backoff_s",
     "service_deadline_s", "service_keep_ckpts", "chaos",
+    # health lane (ISSUE 14): the incident POLICY and its EMA judgement
+    # knobs are host-side (health/monitor.py) and bank verification is
+    # open-time IO — none shapes a traced program (`health` and
+    # `quarantine` by contrast DO and are fingerprinted)
+    "health_policy", "health_z_threshold", "health_spike_factor",
+    "bank_verify",
     # population axis (ISSUE 7): `cohort_sampled` selects the cohort
     # program families (names key the fingerprint, like host_sampled);
     # bank storage location / IO shard layout never shape a program

@@ -13,6 +13,16 @@ This is strictly a debug mode — the instrumentation costs a few percent and
 is off by default. Complementing it, `assert_finite_params` is a cheap
 post-round host-side sanity check the driver can run every snap round at
 negligible cost (one all-reduce over the params).
+
+Since ISSUE 14 these guards are ENDPOINTS of the unified divergence
+policy, not independent policies: every boundary routes through
+``health/monitor.assess``/``enforce`` (``--health_policy
+abort|recover|record``; ``--debug_nan`` forces abort), and ``enforce``
+calls ``finite_warn`` so the historical message and the
+FloatingPointError contract stay word-for-word. Call ``finite_warn``
+directly only from paths that cannot carry the health lane (e.g. the
+multihost pack check) — a second, uncoordinated warn/abort site is the
+drift this module's unification removed.
 """
 
 from __future__ import annotations
