@@ -111,6 +111,13 @@ class ThresholdController:
             defense.get("tel_cos_honest"), defense.get("tel_cos_corrupt"))
         if new == self.thr:
             return None
+        # the decision as a typed ledger record (obs/events.py): the
+        # controller is carried through serve's re-entries, so each move
+        # is emitted exactly once, at the boundary that decided it
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            events as obs_events)
+        obs_events.emit("adapt/move", round=rnd,
+                        thr_from=self.thr, thr_to=new)
         self.moves.append((rnd, self.thr, new))
         self.thr = new
         return new

@@ -347,6 +347,23 @@ class Config:
                                     # status.json — a stable path the
                                     # watchers can find without knowing
                                     # the run name)
+    events: str = "on"              # on | off — the service event ledger
+                                    # (obs/events.py): every lifecycle
+                                    # transition (retries, ladder rungs,
+                                    # adaptation moves, chaos injections,
+                                    # checkpoint save/restore, AOT bank
+                                    # hit/miss) as one typed, seq-numbered
+                                    # record in <run_dir>/events.jsonl;
+                                    # off arms nothing and the metrics
+                                    # stream is bit-identical
+    metrics_port: int = 0           # >0: serve GET /metrics (Prometheus
+                                    # exposition text, obs/export.py) on
+                                    # this port from the service driver;
+                                    # 0 = no HTTP exporter
+    metrics_textfile: str = ""      # path for the atomically-rewritten
+                                    # Prometheus textfile export
+                                    # (node_exporter textfile-collector
+                                    # format); "" = off
     data_dir: str = "./data"
     log_dir: str = "./logs"
     checkpoint_dir: str = ""        # "" disables checkpointing
@@ -576,6 +593,9 @@ FIELD_PROVENANCE = {
     "spans": "runtime",
     "heartbeat": "runtime",
     "status_file": "runtime",
+    "events": "runtime",          # ledger IO only; never read in a trace
+    "metrics_port": "runtime",    # exporter transport knobs
+    "metrics_textfile": "runtime",
     "data_dir": "runtime",
     "log_dir": "runtime",
     "checkpoint_dir": "runtime",
@@ -935,6 +955,20 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                         "(obs/heartbeat.py)")
     p.add_argument("--status_file", type=str, default=d.status_file,
                    help="heartbeat path (default <log_dir>/status.json)")
+    p.add_argument("--events", choices=("on", "off"), default=d.events,
+                   help="service event ledger (obs/events.py): every "
+                        "lifecycle transition as a typed, seq-numbered "
+                        "record in <run_dir>/events.jsonl (off arms "
+                        "nothing; the metrics stream is bit-identical)")
+    p.add_argument("--metrics_port", type=int, default=d.metrics_port,
+                   help=">0: serve GET /metrics (Prometheus exposition "
+                        "text) on this port from the service driver "
+                        "(obs/export.py)")
+    p.add_argument("--metrics_textfile", type=str,
+                   default=d.metrics_textfile,
+                   help="path for the atomically-rewritten Prometheus "
+                        "textfile export (node_exporter "
+                        "textfile-collector format)")
     p.add_argument("--sync_metrics", action="store_true",
                    help="force the synchronous metrics path (float() host "
                         "sync every eval boundary) instead of the async "

@@ -53,6 +53,8 @@ import numpy as np
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     sentinel)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    events as obs_events)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.checkpoint import (
     atomic_write_text)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
@@ -219,6 +221,42 @@ def enforce(cfg, report, where: str = "") -> bool:
     return report["healthy"]
 
 
+# defense-telemetry anomaly thresholds (ROADMAP PR-14 follow-up): the
+# same signatures the adaptation policy acts on (attack/adapt.py), here
+# only OBSERVED — a low-severity ledger event, never a ladder trigger.
+DEFENSE_FLIP_FRAC_HI = 0.5      # defense reversing most coordinates
+DEFENSE_LOW_MARGIN_HI = 0.25    # electorate-splitting histogram mass
+
+
+def defense_anomaly(defense: Optional[Dict]) -> str:
+    """Judge one boundary's drained Defense/* summary
+    (obs/telemetry.host_summary) for the defense-side anomaly
+    signatures; returns the reason string ('' = nothing anomalous).
+
+    Deliberately decoupled from ``assess``: a defense anomaly is the
+    MECHANISM misbehaving (over-flipping, a splitting electorate), not
+    bad numerics — it must be visible in the same event stream as the
+    numerics incidents (the service driver emits it as a LOW-severity
+    ``health/defense_anomaly`` ledger record) without ever feeding the
+    recovery ladder."""
+    if not defense or "tel_flip_frac" not in defense:
+        return ""
+    why = []
+    flip = float(defense["tel_flip_frac"])
+    if flip >= DEFENSE_FLIP_FRAC_HI:
+        why.append(f"flip fraction {flip:.2f} >= {DEFENSE_FLIP_FRAC_HI} "
+                   f"(defense reversing most coordinates)")
+    hist = defense.get("tel_margin_hist")
+    if hist:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.attack.adapt import (
+            low_margin_mass)
+        mass = low_margin_mass(hist)
+        if mass >= DEFENSE_LOW_MARGIN_HI:
+            why.append(f"low-margin vote mass {mass:.2f} >= "
+                       f"{DEFENSE_LOW_MARGIN_HI} (electorate splitting)")
+    return "; ".join(why)
+
+
 # --------------------------------------------------------------- the ladder
 
 
@@ -359,6 +397,14 @@ class HealthLadder:
         self.state["counters"][rung] += 1
         self.state["incidents"] += 1
         self._save()
+        # the rung as a typed ledger record, emitted AFTER the state
+        # save: the ladder state is what guarantees exactly-once across
+        # a kill-mid-recovery resume (the resumed process walks the
+        # journaled ladder, it never re-records the rung)
+        obs_events.emit("health/rung",
+                        severity="error" if rung == "halt" else "warn",
+                        round=rnd, rung=rung,
+                        incidents=self.state["incidents"])
         if sup is not None:
             # a counted, journaled status.json phase per transition —
             # recovery is observable, not inferred from silence

@@ -30,6 +30,29 @@ Three layers, built to be cheap enough to leave on:
                    device span table, collective share per family and
                    memory watermarks, PASS/FAIL-gated against the pinned
                    `obs_baseline.json` budgets.
+
+The fleet plane (ISSUE 15) — cross-run, service-level observability:
+
+- `obs.events`     the structured event ledger: every lifecycle
+                   transition (supervisor retries, recovery-ladder
+                   rungs, adaptation moves, chaos injections, checkpoint
+                   save/restore, AOT bank hit/miss, queue cells) as one
+                   typed, seq-numbered record in `<run_dir>/events.jsonl`
+                   — crash-exact (torn-tail truncation + exactly-once
+                   episodic emission + replay dedupe).
+- `obs.export`     stdlib Prometheus exporter: atomically-rewritten
+                   textfile + optional HTTP `/metrics`
+                   (`--metrics_textfile` / `--metrics_port`).
+- `obs.console`    the fleet console (`python -m ...obs.console
+                   <log_root> [--watch|--html]`): the live multi-run
+                   table from heartbeats + ledgers.
+- `obs.trajectory` the cross-run perf trajectory
+                   (`scripts/bench_trajectory.py`): bench artifacts
+                   folded into the committed `trajectory.json` series,
+                   regressions judged against a pinned tolerance.
+- `obs.constants`  `NON_TIMING_PREFIXES`, the single-sourced exclusion
+                   list every crash-exact metrics byte-compare filters
+                   on.
 """
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs.heartbeat import (  # noqa: F401

@@ -1,0 +1,283 @@
+"""Fleet console: the live multi-run table from heartbeats + ledgers.
+
+    python -m defending_against_backdoors_with_robust_learning_rate_tpu.obs.console \
+        <log_root> [--watch [--interval S]] [--html [--out PATH]]
+
+A fleet is whatever lives under ``<log_root>``: every run directory (a
+dir holding ``metrics.jsonl`` and/or ``events.jsonl``) joined to the
+``status.json`` heartbeat, ``health_state.json`` and exporter textfile
+of its log dir. One row per run::
+
+    RUN            PHASE  ROUND      R/S    VAL  SEQ LAST EVENT        W/E  AGE
+    clip_val:0...  done    8/8     1.234  0.969   12 checkpoint/save   1/0  3s
+
+- PHASE/ROUND/AGE come from the heartbeat (AGE is staleness-aware: a
+  compile-in-flight run gets the larger budget before it reads STALE —
+  obs/heartbeat.is_stale);
+- SEQ + LAST EVENT come from the heartbeat's ledger fields when present
+  (the wedged-ledger detector: SEQ in status.json behind the ledger file
+  means the emitter died mid-run), else from the ledger tail;
+- W/E counts warn/error events in the ledger;
+- R/S and VAL are the last Throughput/Rounds_Per_Sec and
+  Validation/Accuracy rows of metrics.jsonl (tail-read, so a
+  multi-gigabyte stream costs one seek).
+
+``--watch`` redraws every ``--interval`` seconds; ``--html`` writes a
+standalone table (default ``<log_root>/console.html``). Stdlib-only:
+runs on machines without jax. Exit 0 always — the console observes, it
+does not judge (the trajectory gate and obs.report do the judging).
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    heartbeat as hb_mod)
+
+TAIL_BYTES = 1 << 16
+COLUMNS = ("run", "phase", "round", "rps", "val_acc", "ledger_seq",
+           "last_event", "warn_err", "age")
+HEADERS = ("RUN", "PHASE", "ROUND", "R/S", "VAL", "SEQ", "LAST EVENT",
+           "W/E", "AGE")
+
+
+def _tail_lines(path: str, max_bytes: int = TAIL_BYTES) -> List[str]:
+    """The last complete lines of a file, reading at most ``max_bytes``
+    from the end (a seek, not a scan — ledgers and metrics streams can
+    be large)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - max_bytes))
+            data = f.read()
+    except OSError:
+        return []
+    lines = data.split(b"\n")
+    if size > max_bytes:
+        lines = lines[1:]   # first line is almost surely partial
+    return [ln.decode("utf-8", "replace") for ln in lines if ln.strip()]
+
+
+def _tail_records(path: str) -> List[Dict[str, Any]]:
+    out = []
+    for line in _tail_lines(path):
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def _last_metric(records: List[Dict[str, Any]], tag: str
+                 ) -> Optional[float]:
+    for rec in reversed(records):
+        if rec.get("tag") == tag:
+            return float(rec["value"])
+    return None
+
+
+def scan_fleet(log_root: str, now: Optional[float] = None
+               ) -> List[Dict[str, Any]]:
+    """One summary dict per run dir under ``log_root`` (sorted by most
+    recent heartbeat/ledger activity, freshest first)."""
+    now = time.time() if now is None else now
+    root = os.path.abspath(log_root)
+    found: List[tuple] = []
+    runs_per_log_dir: Dict[str, int] = {}
+    for base, dirs, files in os.walk(log_root):
+        dirs.sort()
+        if "metrics.jsonl" not in files and "events.jsonl" not in files:
+            continue
+        # a run dir's heartbeat lives at its PARENT log dir — except a
+        # root-level ledger (the queue's), whose log dir is itself; a
+        # parent outside log_root is never read (it is not this fleet's)
+        log_dir = (os.path.dirname(base)
+                   if os.path.abspath(base) != root else base)
+        found.append((base, files, log_dir))
+        runs_per_log_dir[log_dir] = runs_per_log_dir.get(log_dir, 0) + 1
+    rows: List[Dict[str, Any]] = []
+    for base, files, log_dir in found:
+        status = hb_mod.read_status(os.path.join(log_dir, "status.json"))
+        if status is not None and runs_per_log_dir[log_dir] > 1:
+            # status.json carries no run identity: with several runs in
+            # one log dir it describes only the LATEST writer — showing
+            # it on every row would attribute a live run's phase (and
+            # ledger seq) to long-finished siblings. Each row falls back
+            # to its own ledger tail instead.
+            status = None
+        metrics = (_tail_records(os.path.join(base, "metrics.jsonl"))
+                   if "metrics.jsonl" in files else [])
+        events = (_tail_records(os.path.join(base, "events.jsonl"))
+                  if "events.jsonl" in files else [])
+        warn_err = [0, 0]
+        for rec in events:
+            if rec.get("severity") == "warn":
+                warn_err[0] += 1
+            elif rec.get("severity") == "error":
+                warn_err[1] += 1
+        last_event = (status or {}).get("last_event")
+        if last_event is None and events:
+            last = events[-1]
+            last_event = {"event": last.get("event"),
+                          "severity": last.get("severity"),
+                          "round": last.get("round")}
+        ledger_seq = (status or {}).get("ledger_seq")
+        if ledger_seq is None and events:
+            ledger_seq = events[-1].get("seq")
+        health = None
+        try:
+            with open(os.path.join(log_dir, "health_state.json"),
+                      encoding="utf-8") as f:
+                health = json.load(f)
+        except (OSError, ValueError):
+            pass
+        updated = float((status or {}).get("updated_at", 0.0))
+        rows.append({
+            "run": os.path.basename(base),
+            "run_dir": base,
+            "log_dir": log_dir,
+            "phase": (status or {}).get("phase", "?"),
+            "round": (status or {}).get("round"),
+            "rounds": (status or {}).get("rounds"),
+            "stale": hb_mod.is_stale(status, now=now),
+            "age_s": (now - updated) if updated else None,
+            "rps": _last_metric(metrics, "Throughput/Rounds_Per_Sec"),
+            "val_acc": _last_metric(metrics, "Validation/Accuracy"),
+            "ledger_seq": ledger_seq,
+            "last_event": last_event,
+            "warns": warn_err[0],
+            "errors": warn_err[1],
+            "health_incidents": (health or {}).get("incidents"),
+        })
+    rows.sort(key=lambda r: (r["age_s"] if r["age_s"] is not None
+                             else float("inf"), r["run"]))
+    return rows
+
+
+# --------------------------------------------------------------------------
+# rendering
+# --------------------------------------------------------------------------
+
+def _fmt_age(row: Dict[str, Any]) -> str:
+    age = row.get("age_s")
+    if age is None:
+        return "—"
+    text = (f"{age:.0f}s" if age < 120 else f"{age / 60:.0f}m"
+            if age < 7200 else f"{age / 3600:.1f}h")
+    return f"{text} STALE" if row.get("stale") else text
+
+
+def _cells(row: Dict[str, Any]) -> List[str]:
+    last = row.get("last_event") or {}
+    ev = last.get("event") or "—"
+    if last.get("round") is not None:
+        ev += f"@{last['round']}"
+    rnd = ("—" if row.get("round") is None
+           else f"{row['round']}/{row.get('rounds') or '?'}")
+    return [
+        row["run"],
+        str(row.get("phase", "?")),
+        rnd,
+        "—" if row.get("rps") is None else f"{row['rps']:.3f}",
+        "—" if row.get("val_acc") is None else f"{row['val_acc']:.3f}",
+        "—" if row.get("ledger_seq") is None else str(row["ledger_seq"]),
+        ev,
+        f"{row.get('warns', 0)}/{row.get('errors', 0)}",
+        _fmt_age(row),
+    ]
+
+
+def render_table(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "(no runs found)\n"
+    table = [list(HEADERS)] + [_cells(r) for r in rows]
+    # RUN is left-justified and width-capped; everything else right-just
+    widths = [min(44, max(len(t[i]) for t in table))
+              for i in range(len(HEADERS))]
+    lines = []
+    for t in table:
+        cells = [t[0][:widths[0]].ljust(widths[0])]
+        cells += [t[i][:widths[i]].rjust(widths[i])
+                  for i in range(1, len(HEADERS))]
+        lines.append("  ".join(cells))
+    return "\n".join(lines) + "\n"
+
+
+def render_html(rows: List[Dict[str, Any]], log_root: str) -> str:
+    head = "".join(f"<th>{h}</th>" for h in HEADERS)
+    body = []
+    for row in rows:
+        cls = ("stale" if row.get("stale")
+               else "err" if row.get("errors") else "")
+        tds = "".join(f"<td>{html.escape(c)}</td>" for c in _cells(row))
+        body.append(f'<tr class="{cls}">{tds}</tr>')
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<meta http-equiv="refresh" content="10">
+<title>fleet console — {html.escape(log_root)}</title>
+<style>
+body {{ font: 13px/1.5 monospace; margin: 1.5em; }}
+table {{ border-collapse: collapse; }}
+th, td {{ padding: 2px 10px; text-align: right; }}
+th:first-child, td:first-child {{ text-align: left; }}
+th {{ border-bottom: 1px solid #888; }}
+tr.stale td {{ color: #a40; }}
+tr.err td {{ color: #c00; }}
+</style></head><body>
+<h3>fleet console — {html.escape(os.path.abspath(log_root))}</h3>
+<p>{len(rows)} run(s) · generated {time.strftime('%Y-%m-%d %H:%M:%S')}</p>
+<table><tr>{head}</tr>
+{os.linesep.join(body)}
+</table></body></html>
+"""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs.console",
+        description="Live multi-run fleet table from heartbeats + event "
+                    "ledgers under one log root")
+    ap.add_argument("log_root", help="directory holding run log dirs")
+    ap.add_argument("--watch", action="store_true",
+                    help="redraw every --interval seconds until ^C")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--html", action="store_true",
+                    help="write a standalone HTML table instead of text")
+    ap.add_argument("--out", default="",
+                    help="HTML output path "
+                         "(default <log_root>/console.html)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.log_root):
+        print(f"[console] not a directory: {args.log_root}",
+              file=sys.stderr)
+        return 2
+    if args.html:
+        rows = scan_fleet(args.log_root)
+        out = args.out or os.path.join(args.log_root, "console.html")
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(render_html(rows, args.log_root))
+        print(f"[console] {out} ({len(rows)} run(s))")
+        return 0
+    while True:
+        table = render_table(scan_fleet(args.log_root))
+        if args.watch:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(table)
+        sys.stdout.flush()
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

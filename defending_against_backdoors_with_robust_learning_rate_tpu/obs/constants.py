@@ -1,0 +1,25 @@
+"""Shared observability constants — single-sourced, import-cheap.
+
+``NON_TIMING_PREFIXES`` is THE exclusion list for crash-exact /
+cross-layout metrics-row comparisons: rows whose tag starts with one of
+these prefixes measure wall-clock time, service-life counters or
+machine-local memory, and legitimately differ between two runs of the
+same seed/config. Every byte-compare of ``metrics.jsonl`` streams —
+tests/test_service.py, tests/test_health.py, tests/test_obs.py,
+tests/test_async_metrics.py, the CI parity steps
+(.github/workflows/ci.yml) and the verify-skill drill recipes — must
+filter on this tuple instead of hand-duplicating it (the list drifted
+once per PR between PR 7 and PR 14).
+
+Stdlib-only on purpose: CI heredocs and the run-report tooling import it
+on machines without jax.
+"""
+
+NON_TIMING_PREFIXES = (
+    "Throughput/",   # rounds/sec — wall-clock by definition
+    "Service/",      # retry/degradation counters — service-life, not math
+    "Spans/",        # host span aggregates — wall-clock, mode-specific sets
+    "Memory/",       # HBM/RSS watermarks — machine-local
+    "Device/",       # profiler attribution — wall-clock, capture-dependent
+    "_run/",         # the _run/start stream-boundary stamp
+)

@@ -1,0 +1,278 @@
+"""The structured event ledger: one typed, seq-numbered ``events.jsonl``
+stream per run.
+
+Every lifecycle transition the service plane performs — supervisor
+retry/backoff/degrade, recovery-ladder rungs, RLR-adaptation decisions,
+queue cell/pack start-finish-fail-fallback, chaos injections, checkpoint
+save/restore/digest-fallback, AOT bank hit/miss — was previously buried
+in prints and status.json phases. The ledger makes each one a record::
+
+    {"seq": 12, "event": "health/rung", "severity": "warn",
+     "run": "<run_name>", "corr": "a1b2c3d4e5f6", "round": 4,
+     "t": 1754280000.123, "rung": "rollback"}
+
+Schema invariants:
+
+- ``seq`` is strictly increasing per ledger file (resumes continue the
+  numbering from the file on disk);
+- ``corr`` is the run's correlation id — a pure function of the run name
+  (``corr_id``), so every segment of one logical service run (adaptation
+  re-entries, recovery-ladder re-entries, crash resumes in a NEW process)
+  threads the same id, and a fleet console can group multi-segment
+  streams without any shared mutable state;
+- ``t`` is the only wall-clock field: ``strip_wallclock`` removes it for
+  the byte-identity comparisons.
+
+**Crash-exactness.** The metrics stream's splice machinery (truncate to
+the journaled offset + deterministic replay) would be WRONG here: a
+recovery-ladder rung recorded after the last checkpoint must survive the
+resume — truncating it would erase exactly the evidence the ledger
+exists to keep, and the rungs are never re-emitted (the ladder's
+persisted state says they already happened). The ledger is therefore
+append-only with three complementary guarantees:
+
+1. **torn-tail truncation** — a SIGKILL mid-write leaves at most one
+   partial line; opening the ledger truncates the file back to the last
+   complete, parseable record (the splice analog, applied only to the
+   torn tail);
+2. **exactly-once episodic events** — retries, rungs, chaos injections
+   and adaptation moves are gated by their subsystems' persisted state
+   (chaos fire counts, health_state.json, the carried controller), so a
+   crash-resumed process never re-emits them;
+3. **replay dedupe** — events a crash-exact replay legitimately
+   re-performs (``checkpoint/save``, ``health/defense_anomaly``) carry a
+   per-event round high-water mark rebuilt from the file at open:
+   re-emission for a round at or below the mark is suppressed.
+
+Together these make a ``kill_recover@N`` drill's ledger byte-identical
+(modulo ``t``) to its unkilled twin's: both walk the same ladder, both
+re-enter through the same crash-exact machinery, and the kill adds no
+record (a dying process writes no last word — the SIGKILL family is the
+one chaos class deliberately NOT ledgered; the recovery it forces is).
+A plain ``kill@N`` resume additionally records the new process's real
+actions (``service/recover``, ``checkpoint/restore``, ``aot/*``) — facts
+an uninterrupted twin genuinely lacks; ``PER_LIFE_PREFIXES`` names them
+for comparisons that want the interruption-invariant stream.
+
+Emission is decoupled from plumbing: ``install``/``emit`` hold one
+process-wide active ledger (the service driver installs its run ledger;
+everything else — supervisor, chaos, health ladder, checkpoint utils,
+AOT bank — calls ``emit`` which no-ops when nothing is installed, so the
+one-shot trainer and bare tests pay nothing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+SEVERITIES = ("info", "warn", "error")
+# "info" is the LOW severity: ledger-visible, never a ladder trigger
+# (health/monitor.defense_anomaly emits at this level by contract).
+
+# events a crash-exact replay legitimately re-performs: deduped by a
+# monotone per-event round high-water mark (rounds only move forward
+# past the resume point, so a scalar mark suffices)
+REPLAY_DEDUPE_EVENTS = ("checkpoint/save", "health/defense_anomaly")
+
+# records that document one PROCESS LIFE's real actions rather than the
+# run's logical history: an interrupted-and-resumed run has more of them
+# than its uninterrupted twin by construction. Comparisons that want the
+# interruption-invariant stream filter these (and, because the extra
+# records shift the numbering, also drop `seq`).
+PER_LIFE_PREFIXES = ("service/recover", "checkpoint/restore", "aot/")
+
+WALLCLOCK_FIELDS = ("t",)
+
+# the SIGKILL chaos family is never ledgered (see module docstring)
+_UNLEDGERED_CHAOS = ("kill", "kill_midbuf", "kill_recover")
+
+
+def corr_id(name: str) -> str:
+    """The correlation id for a logical run: a pure function of its
+    name, so every segment/process of the run derives the same id with
+    no shared state (and twin drills stay byte-comparable)."""
+    return hashlib.sha256(name.encode()).hexdigest()[:12]
+
+
+class EventLedger:
+    """Append-only ``events.jsonl`` writer with torn-tail recovery,
+    resumed seq numbering and replay dedupe (module docstring).
+
+    ``on_emit(record)`` is the heartbeat hook: the service driver wires
+    it to ``status.json`` so readers can detect a wedged ledger
+    (``ledger_seq`` + ``last_event``) without tailing the file. Like the
+    heartbeat, IO failure disables the ledger rather than the run."""
+
+    def __init__(self, path: str, run: str = "", corr: str = "",
+                 on_emit: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 clock=time.time):
+        self.path = path
+        self.run = run
+        self.corr = corr or corr_id(run)
+        self.on_emit = on_emit
+        self._clock = clock
+        self._f = None
+        self.seq = 0
+        self._dedupe_hw: Dict[str, int] = {}
+        self.enabled = bool(path)
+        if not self.enabled:
+            return
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._recover_tail()
+            self._f = open(path, "ab")
+        except OSError:
+            self.enabled = False
+
+    # ------------------------------------------------------------ recovery
+
+    def _recover_tail(self) -> None:
+        """Truncate a torn tail back to the last complete, parseable
+        line; resume the seq numbering and rebuild the replay-dedupe
+        high-water marks from the surviving records."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        good_end = 0
+        for line in data.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break   # torn tail: a kill landed mid-write
+            try:
+                rec = json.loads(line)
+                self.seq = int(rec["seq"]) + 1
+            except (ValueError, KeyError, TypeError):
+                break   # corrupt line: everything after it is suspect
+            event = rec.get("event")
+            rnd = rec.get("round")
+            if event in REPLAY_DEDUPE_EVENTS and isinstance(rnd, int):
+                self._dedupe_hw[event] = max(
+                    self._dedupe_hw.get(event, -1), rnd)
+            good_end += len(line)
+        if good_end < len(data):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+
+    # ------------------------------------------------------------ emission
+
+    def emit(self, event: str, severity: str = "info",
+             round: Optional[int] = None,  # noqa: A002 — schema field name
+             **fields) -> Optional[Dict[str, Any]]:
+        """Write one record; returns it (or None when suppressed or the
+        ledger is disabled). Field order is fixed (schema head, then
+        sorted extras) so identical event sequences produce identical
+        bytes modulo the ``t`` stamp."""
+        if not self.enabled:
+            return None
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}, "
+                             f"got {severity!r}")
+        if event in REPLAY_DEDUPE_EVENTS and round is not None:
+            if round <= self._dedupe_hw.get(event, -1):
+                return None   # a crash-exact replay re-performing the act
+            self._dedupe_hw[event] = round
+        rec: Dict[str, Any] = {
+            "seq": self.seq, "event": event, "severity": severity,
+            "run": self.run, "corr": self.corr, "round": round,
+            "t": self._clock(),
+        }
+        for key in sorted(fields):
+            rec[key] = fields[key]
+        try:
+            self._f.write((json.dumps(rec) + "\n").encode())
+            self._f.flush()
+        except (OSError, ValueError):
+            self.enabled = False   # observability never takes down the run
+            return None
+        self.seq += 1
+        if self.on_emit is not None:
+            self.on_emit(rec)
+        return rec
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        self.enabled = False
+
+
+# --------------------------------------------------------------------------
+# the process-wide active ledger (service-plane plumbing)
+# --------------------------------------------------------------------------
+
+_ACTIVE: Optional[EventLedger] = None
+
+
+def install(ledger: Optional[EventLedger]) -> Optional[EventLedger]:
+    """Make ``ledger`` the process-wide emission target; returns the
+    previous one so callers can restore it (the queue's serve cells nest
+    this way). ``install(None)`` clears."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, ledger
+    return prev
+
+
+def active() -> Optional[EventLedger]:
+    return _ACTIVE
+
+
+def emit(event: str, severity: str = "info",
+         round: Optional[int] = None,  # noqa: A002 — schema field name
+         **fields) -> Optional[Dict[str, Any]]:
+    """Emit through the installed ledger; a no-op when none is installed
+    (the one-shot trainer, bare engine tests, non-lead processes)."""
+    if _ACTIVE is None:
+        return None
+    return _ACTIVE.emit(event, severity=severity, round=round, **fields)
+
+
+def chaos_ledgered(action: str) -> bool:
+    """Whether a chaos injection class is recorded in the ledger (the
+    SIGKILL family is not — module docstring)."""
+    return action not in _UNLEDGERED_CHAOS
+
+
+# --------------------------------------------------------------------------
+# readers (tests, CI drills, the fleet console)
+# --------------------------------------------------------------------------
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a ledger file; unparseable/torn lines terminate the read
+    (they are what a fresh writer would truncate)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    break
+    except OSError:
+        return []
+    return out
+
+
+def strip_wallclock(records: List[Dict[str, Any]],
+                    drop_per_life: bool = False) -> List[Dict[str, Any]]:
+    """The comparison view: records minus the wall-clock fields.
+    ``drop_per_life`` additionally removes the per-process-life records
+    (and then ``seq``, which the removals shift) — the interruption-
+    invariant stream a ``kill@N`` drill compares against its
+    uninterrupted twin."""
+    out = []
+    for rec in records:
+        if drop_per_life and str(rec.get("event", "")).startswith(
+                PER_LIFE_PREFIXES):
+            continue
+        keep = {k: v for k, v in rec.items()
+                if k not in WALLCLOCK_FIELDS
+                and not (drop_per_life and k == "seq")}
+        out.append(keep)
+    return out

@@ -1,0 +1,243 @@
+"""Prometheus exporter: textfile collector + optional HTTP scrape
+endpoint, stdlib-only.
+
+The service plane already computes its operational truth (heartbeat
+phases, supervisor counters, ladder census, drained eval scalars, HBM
+watermarks); this module publishes it in the one format every metrics
+stack ingests::
+
+    # HELP rlr_rounds_per_sec_ema EMA of observed rounds/sec
+    # TYPE rlr_rounds_per_sec_ema gauge
+    rlr_rounds_per_sec_ema{run="clip_val:0.0-..."} 1.234
+
+Two transports, independently armed:
+
+- **textfile** (``--metrics_textfile PATH``): the file is atomically
+  rewritten (tmp + ``os.replace``, the heartbeat idiom) at every update,
+  ready for node_exporter's textfile collector — zero open ports, works
+  on an air-gapped TPU host;
+- **HTTP** (``--metrics_port N``): a daemon-thread ``http.server``
+  serving ``GET /metrics`` (port 0 binds an ephemeral port — the test
+  hook; ``.port`` reports the bound one).
+
+Provenance rides a ``<ns>_build_info`` gauge (value 1, labels carry the
+run name / backend / jax version), the Prometheus convention for
+runtime identity. Like every obs component, IO failure disables the
+exporter rather than the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+DEFAULT_NAMESPACE = "rlr"
+EMA_ALPHA = 0.3
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labelstr(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class MetricsExporter:
+    """A small gauge/counter registry with Prometheus text rendering.
+
+    ``set`` registers/updates one series; ``observe_rounds`` derives the
+    rounds/sec EMA from successive absolute round counts (negative
+    deltas — a recovery rollback — are skipped rather than folded into
+    the rate). ``flush`` rewrites the textfile; the HTTP endpoint
+    renders on demand and needs no flush."""
+
+    def __init__(self, port: Optional[int] = None, textfile: str = "",
+                 info: Optional[Dict[str, str]] = None,
+                 base_labels: Optional[Dict[str, str]] = None,
+                 namespace: str = DEFAULT_NAMESPACE, clock=time.time):
+        self.namespace = namespace
+        self.textfile = textfile
+        self.base_labels = dict(base_labels or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        # name -> (help, type, {labelstr: value})
+        self._series: Dict[str, Tuple[str, str, Dict[str, float]]] = {}
+        self._ema = None
+        self._last_obs: Optional[Tuple[float, float]] = None
+        self.enabled = True
+        self.set("build_info", 1.0, labels=dict(info or {}),
+                 help_text="runtime provenance (value is always 1)")
+        self.port: Optional[int] = None
+        self._server = None
+        self._thread = None
+        if port is not None:
+            try:
+                self._server = ThreadingHTTPServer(
+                    ("", port), _make_handler(self))
+                self.port = self._server.server_address[1]
+                self._thread = threading.Thread(
+                    target=self._server.serve_forever,
+                    name="metrics-exporter", daemon=True)
+                self._thread.start()
+            except OSError as e:
+                print(f"[export] metrics port {port} unavailable "
+                      f"({e}); HTTP exporter disabled, textfile "
+                      f"(if armed) continues")
+                self._server = None
+
+    # ------------------------------------------------------------- registry
+
+    def set(self, name: str, value, labels: Optional[Dict[str, str]] = None,
+            mtype: str = "gauge", help_text: str = "") -> None:
+        merged = {**self.base_labels, **(labels or {})}
+        with self._lock:
+            help_str, type_str, values = self._series.get(
+                name, (help_text, mtype, {}))
+            values[_labelstr(merged)] = float(value)
+            # the registered TYPE/HELP are first-wins: a later value
+            # update that omits mtype must not flip a counter to gauge
+            self._series[name] = (help_str or help_text, type_str, values)
+
+    def observe_rounds(self, rounds_total: float) -> None:
+        """Fold an absolute round count into the rounds/sec EMA."""
+        now = self._clock()
+        if self._last_obs is not None:
+            last_t, last_r = self._last_obs
+            dt, dr = now - last_t, rounds_total - last_r
+            if dt > 0 and dr > 0:
+                rate = dr / dt
+                self._ema = (rate if self._ema is None
+                             else EMA_ALPHA * rate
+                             + (1 - EMA_ALPHA) * self._ema)
+        self._last_obs = (now, rounds_total)
+        if self._ema is not None:
+            self.set("rounds_per_sec_ema", self._ema,
+                     help_text="EMA of observed rounds/sec")
+        self.set("rounds_observed_total", rounds_total, mtype="counter",
+                 help_text="latest absolute round count observed")
+
+    # ------------------------------------------------------------ rendering
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            for name in sorted(self._series):
+                help_str, mtype, values = self._series[name]
+                full = f"{self.namespace}_{name}"
+                if help_str:
+                    lines.append(f"# HELP {full} {help_str}")
+                lines.append(f"# TYPE {full} {mtype}")
+                for labelstr, value in sorted(values.items()):
+                    if value == int(value) and abs(value) < 1e15:
+                        rendered = str(int(value))
+                    else:
+                        rendered = repr(value)
+                    lines.append(f"{full}{labelstr} {rendered}")
+        return "\n".join(lines) + "\n"
+
+    def flush(self) -> None:
+        """Atomically rewrite the textfile (no-op without one)."""
+        if not (self.textfile and self.enabled):
+            return
+        tmp = f"{self.textfile}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(self.textfile) or ".",
+                        exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(self.render())
+            os.replace(tmp, self.textfile)
+        except OSError:
+            self.enabled = False   # observability never takes down the run
+
+    def close(self) -> None:
+        self.flush()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def _make_handler(exporter: MetricsExporter):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = exporter.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass   # scrapes must not spam the service's stdout
+
+    return Handler
+
+
+# --------------------------------------------------------------------------
+# parsing (tests + the fleet console read scrapes back)
+# --------------------------------------------------------------------------
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
+    """{metric_name: {labelstr: value}} from Prometheus exposition text.
+    Raises ValueError on a malformed sample line — the scrape-validity
+    check the CI job runs."""
+    out: Dict[str, Dict[str, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            raise ValueError(f"malformed sample line: {line!r}")
+        value = float(value_part)   # ValueError on garbage
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            if not rest.endswith("}"):
+                raise ValueError(f"malformed labels: {line!r}")
+            labelstr = "{" + rest
+        else:
+            name, labelstr = name_part, ""
+        out.setdefault(name, {})[labelstr] = value
+    return out
+
+
+def read_textfile(path: str) -> Dict[str, Dict[str, float]]:
+    with open(path, encoding="utf-8") as f:
+        return parse_prometheus_text(f.read())
+
+
+def summary_labels(path: str) -> Dict[str, str]:
+    """The build_info label set of a textfile scrape (console helper);
+    {} when absent/unreadable."""
+    try:
+        metrics = read_textfile(path)
+    except (OSError, ValueError):
+        return {}
+    for name, series in metrics.items():
+        if name.endswith("_build_info"):
+            for labelstr in series:
+                pairs = {}
+                for part in labelstr.strip("{}").split(","):
+                    if "=" in part:
+                        k, _, v = part.partition("=")
+                        pairs[k] = json.loads(v)   # unquote
+                return pairs
+    return {}

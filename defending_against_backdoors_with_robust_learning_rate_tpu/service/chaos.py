@@ -72,6 +72,8 @@ import signal
 import time
 from typing import Dict, List, Optional
 
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    events as obs_events)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.checkpoint import (
     atomic_write_text)
 
@@ -138,6 +140,14 @@ class Chaos:
         self._fired[inj.key] = self._fired.get(inj.key, 0) + 1
         if self.state_path:
             atomic_write_text(self.state_path, json.dumps(self._fired))
+        # one typed ledger record per fired injection — except the
+        # SIGKILL family: a dying process writes no last word, and the
+        # kill-vs-no-kill twin drills demand byte-identical ledgers
+        # (obs/events.py module doc). Fire counts persist, so a
+        # crash-resumed replay never re-emits.
+        if obs_events.chaos_ledgered(inj.action):
+            obs_events.emit(f"chaos/{inj.action}", severity="warn",
+                            round=inj.rnd, fired=self._fired[inj.key])
 
     def _due(self, action: str, rnd: int) -> Optional[Injection]:
         for inj in self.injections:

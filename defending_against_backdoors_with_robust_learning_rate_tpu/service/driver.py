@@ -50,6 +50,9 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
     Config, args_parser)
 from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     monitor as health_monitor)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    attribution as obs_attribution, events as obs_events,
+    export as obs_export)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
     chaos as chaos_mod, churn as churn_mod)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service.supervisor import (
@@ -71,6 +74,10 @@ CENSUS_MAX_POPULATION = 100_000
 
 def _metrics_path(cfg: Config) -> str:
     return os.path.join(cfg.log_dir, run_name(cfg), "metrics.jsonl")
+
+
+def _events_path(cfg: Config) -> str:
+    return os.path.join(cfg.log_dir, run_name(cfg), "events.jsonl")
 
 
 def prepare_crash_exact_resume(cfg: Config, truncate: bool = True) -> Dict:
@@ -136,7 +143,8 @@ def prepare_crash_exact_resume(cfg: Config, truncate: bool = True) -> Dict:
 def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
           max_rounds: Optional[int] = None, _adapt=None,
           _adapt_reentry: bool = False, _health=None,
-          _phases: Optional[List[str]] = None) -> Dict:
+          _phases: Optional[List[str]] = None, _ledger=None,
+          _export=None) -> Dict:
     """Run the continuous service; returns the engine summary extended
     with a ``service`` section (retry/degradation counters, recovery
     info).
@@ -149,7 +157,55 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
     ``robustLR_threshold=<new>`` — same writer (one continuous metrics
     stream), same checkpoint dir, the controller carried through
     (``_adapt``) so its cadence and decision log survive the restart.
-    Revisited thresholds are AOT/XLA cache hits, not recompiles."""
+    Revisited thresholds are AOT/XLA cache hits, not recompiles.
+
+    Observability plane (ISSUE 15): with ``--events on`` (the default)
+    a lead-process event ledger (obs/events.py) records every lifecycle
+    transition into ``<run_dir>/events.jsonl``; ``--metrics_port`` /
+    ``--metrics_textfile`` arm the Prometheus exporter (obs/export.py).
+    Both are carried through every re-entry (``_ledger`` / ``_export``)
+    — one ledger stream and one scrape endpoint per logical run, whoever
+    created them closes them."""
+    lead = jax.process_index() == 0
+    ledger, created_ledger = _ledger, False
+    if ledger is None and lead and cfg.events == "on":
+        run = run_name(cfg)
+        ledger = obs_events.EventLedger(_events_path(cfg), run=run,
+                                        corr=obs_events.corr_id(run))
+        created_ledger = True
+    exporter, created_export = _export, False
+    if exporter is None and lead and (cfg.metrics_port > 0
+                                      or cfg.metrics_textfile):
+        run = run_name(cfg)
+        exporter = obs_export.MetricsExporter(
+            port=cfg.metrics_port if cfg.metrics_port > 0 else None,
+            textfile=cfg.metrics_textfile,
+            info={"run": run, "backend": jax.default_backend(),
+                  "jax_version": jax.__version__},
+            base_labels={"run": run})
+        created_export = True
+        if exporter.port:
+            print(f"[export] Prometheus /metrics on port {exporter.port}"
+                  + (f" + textfile {cfg.metrics_textfile}"
+                     if cfg.metrics_textfile else ""))
+        elif cfg.metrics_textfile:
+            print(f"[export] Prometheus textfile {cfg.metrics_textfile}")
+    prev_ledger = obs_events.install(ledger)
+    try:
+        return _serve(cfg, writer, max_rounds, _adapt, _adapt_reentry,
+                      _health, _phases, ledger, exporter)
+    finally:
+        obs_events.install(prev_ledger)
+        if created_export and exporter is not None:
+            exporter.close()
+        if created_ledger and ledger is not None:
+            ledger.close()
+
+
+def _serve(cfg: Config, writer, max_rounds, _adapt, _adapt_reentry,
+           _health, _phases, ledger, exporter) -> Dict:
+    """The supervised round stream (see ``serve``); runs with the
+    ledger installed as the process-wide emission target."""
     t_start = time.perf_counter()
     total = max_rounds if max_rounds is not None else cfg.service_rounds
     # supervision granularity is one round per dispatch unit; `rounds`
@@ -180,6 +236,15 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                                    boundary=recovery["boundary"])
         else:
             writer = NullWriter()
+    if recovery["boundary"]:
+        # the ledger's stream-segment boundary, mirroring the metrics
+        # _run/start semantics: a fresh stream (or a pre-journal append)
+        # starts a segment; a crash-exact splice and the in-process
+        # re-entries do NOT — their streams must byte-match an
+        # uninterrupted run's. Deliberately field-free: the round budget
+        # lives in the heartbeat, and an interrupted run relaunched with
+        # a different --service_rounds must still splice byte-identically
+        obs_events.emit("service/start")
 
     chaos = chaos_mod.Chaos(
         cfg.chaos, state_path=(os.path.join(cfg.log_dir, "chaos_state.json")
@@ -246,6 +311,18 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
     sup = Supervisor(retries=cfg.service_retries,
                      backoff_s=cfg.service_backoff_s,
                      deadline_s=cfg.service_deadline_s, hb=eng.hb)
+    if ledger is not None:
+        # heartbeat upgrade (ISSUE 15 satellite): every emitted record
+        # mirrors its seq + identity into status.json, so watchers can
+        # detect a wedged ledger without tailing events.jsonl. Rides the
+        # heartbeat's normal rate limit — event churn must not become
+        # fsync churn.
+        def _hb_event(rec, hb=eng.hb):
+            hb.update(ledger_seq=rec["seq"],
+                      last_event={"event": rec["event"],
+                                  "severity": rec["severity"],
+                                  "round": rec["round"]})
+        ledger.on_emit = _hb_event
     if _phases:
         # in-process re-entry (health ladder / adaptation): the phase
         # history is one continuous record — status.json must still show
@@ -253,6 +330,14 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         sup.phases_seen.extend(_phases)
     if recovery["resumed_from"] and eng.start_round:
         sup.phase("recover", recovered_round=eng.start_round)
+        # a per-life record (obs/events.PER_LIFE_PREFIXES): the resumed
+        # process's real action. Deliberately WITHOUT truncated_bytes:
+        # that value counts whatever rows were flushed before death —
+        # buffer state, not logical history — and would break the
+        # kill-vs-no-kill twin byte-identity (it stays in the run
+        # summary's service section, where it belongs)
+        obs_events.emit("service/recover", round=eng.start_round,
+                        resumed_from=recovery["resumed_from"])
         print(f"[service] recovered at round {eng.start_round} "
               f"in {time.perf_counter() - t_start:.2f}s")
     stop_path = os.path.join(cfg.log_dir, STOP_FILE)
@@ -322,6 +407,9 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                         # training — a broken eval set must not take down
                         # the service
                         evals_skipped += 1
+                        obs_events.emit("service/eval_skipped",
+                                        severity="warn", round=rnd,
+                                        classification=e.classification)
                         print(f"[service] degraded: eval at round {rnd} "
                               f"skipped ({e.classification}); training "
                               f"continues")
@@ -333,6 +421,9 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                     # metrics and replay the boundary inline — if THAT
                     # fails too, exit loudly with the journal intact.
                     sup.phase("degraded", drain_dead_round=rnd)
+                    obs_events.emit("service/drain_degraded",
+                                    severity="warn", round=rnd,
+                                    mode="dead")
                     print("[service] degraded: metrics drain died — "
                           "falling back to synchronous metrics and "
                           f"replaying round {rnd}'s eval inline")
@@ -362,6 +453,9 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                         # close() gives the wedged callback a bounded
                         # grace to finish (its rows land in order), then
                         # the service continues inline.
+                        obs_events.emit("service/drain_degraded",
+                                        severity="warn", round=rnd,
+                                        mode="wedged")
                         print("[service] degraded: metrics drain wedged — "
                               "falling back to synchronous metrics")
                         eng.drain.close(raise_errors=False,
@@ -376,6 +470,25 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                         "Service/Active_Clients",
                         churn_mod.active_count(cfg, rnd), rnd)
                 _emit_service_rows(eng, sup, evals_skipped, rnd)
+                if eng.mstate.get("defense_round") == rnd:
+                    # anomaly-gated defense telemetry (ISSUE 15
+                    # satellite): the drained flip-fraction / margin
+                    # summary judged for over-defense and electorate
+                    # splitting — a LOW-severity ledger record in the
+                    # same stream as the numerics incidents, never a
+                    # ladder trigger. Replay-deduped, so a rollback's
+                    # re-evaluated boundary re-emits nothing.
+                    why = health_monitor.defense_anomaly(
+                        eng.mstate.get("defense"))
+                    if why:
+                        obs_events.emit(
+                            "health/defense_anomaly", severity="info",
+                            round=rnd, why=why,
+                            flip_frac=float(eng.mstate["defense"]
+                                            ["tel_flip_frac"]))
+                if exporter is not None:
+                    _update_exporter(exporter, eng, sup, ladder,
+                                     evals_skipped, rnd, ledger)
                 if (adapt is not None
                         and eng.mstate.get("defense_round") == rnd):
                     # the boundary's checkpoint step flushed the drain,
@@ -409,6 +522,12 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
     if recover_to is not None:
         eng.hb.update(phase=f"health_{recover_to.rung}", force=True,
                       health_round=recover_to.rnd)
+        # flushed BEFORE the kill-mid-recovery window below, so a killed
+        # and an unkilled recovery leave byte-identical ledgers: the
+        # resumed process walks the journaled ladder and re-emits nothing
+        obs_events.emit("health/reenter", severity="warn",
+                        round=recover_to.rnd, rung=recover_to.rung,
+                        quarantine=recover_to.quarantine)
         # kill-mid-rollback drill window: the rung is recorded (ladder
         # state saved) and the engine is closed, but recovery has not
         # completed — a kill HERE must resume the ladder, not the failure
@@ -442,7 +561,8 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         # crash-exact truncate (run_name deliberately ignores
         # --quarantine, so the stream path is unchanged)
         sub = serve(new_cfg, writer=None, max_rounds=total, _adapt=adapt,
-                    _health=ladder, _phases=sup.phases_seen)
+                    _health=ladder, _phases=sup.phases_seen,
+                    _ledger=ledger, _export=exporter)
         svc = sub.setdefault("service", {})
         # rounds_served counts DISTINCT rounds: the inner serve resumed
         # from a checkpoint BEHIND this segment's last round and
@@ -476,7 +596,8 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         sub = serve(cfg.replace(robustLR_threshold=new_thr),
                     writer=writer, max_rounds=total, _adapt=adapt,
                     _adapt_reentry=True, _health=ladder,
-                    _phases=sup.phases_seen)
+                    _phases=sup.phases_seen, _ledger=ledger,
+                    _export=exporter)
         # the reliability record must cover the WHOLE run, not just the
         # last segment: fold this segment's supervisor counters into the
         # inner serve's service section
@@ -498,6 +619,11 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         return sub
     eng.hb.update(force=True, evals_skipped=evals_skipped,
                   **sup.heartbeat_fields())
+    if exporter is not None:
+        # final scrape state before the writer closes — a fleet console
+        # polling the textfile sees the finished run's last values
+        _update_exporter(exporter, eng, sup, ladder, evals_skipped,
+                         eng.rnd, ledger)
     summary = eng.finalize()
     summary["service"] = {
         **sup.counters,
@@ -508,6 +634,8 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
         "rounds_served": eng.rounds_done,
         "wall_s": round(time.perf_counter() - t_start, 3),
     }
+    if ledger is not None:
+        summary["service"]["ledger_events"] = ledger.seq
     if ladder is not None:
         summary["service"]["health"] = ladder.summary()
     print(f"[service] served {eng.rounds_done} round(s); "
@@ -551,7 +679,14 @@ def _run_ladder(cfg, eng, sup, ladder, chaos, rnd: int, unit,
     re-enters through the crash-exact machinery), then HALT loudly."""
     model_prev = prev_params[0] if eng.async_mode else prev_params
     report = ladder.check(cfg, eng, rnd, prev_params=model_prev)
+    incident_emitted = False
     while not report["healthy"]:
+        if not incident_emitted:
+            # one typed record per incident episode (the rung records
+            # below count the escalation walk)
+            obs_events.emit("health/incident", severity="warn",
+                            round=rnd, why=report["why"])
+            incident_emitted = True
         # the QUARANTINE rung feeds --quarantine, which the host-sampled
         # program refuses (it never sees the sampled client ids) — that
         # path escalates past it. DISCARD is safe everywhere: the
@@ -587,6 +722,52 @@ def _run_ladder(cfg, eng, sup, ladder, chaos, rnd: int, unit,
                 f"health ladder exhausted at round {rnd}: "
                 f"{report['why']}"))
     ladder.note_healthy(report)
+
+
+def _update_exporter(exporter, eng, sup: Supervisor, ladder,
+                     evals_skipped: int, rnd: int, ledger) -> None:
+    """Publish the boundary's service state through the Prometheus
+    exporter (obs/export.py): heartbeat-plane gauges, supervisor/ladder
+    counters, the drained eval scalars and the HBM watermarks — then
+    rewrite the textfile. Values come from host state the boundary's
+    drain flush already materialized; nothing here touches the device
+    beyond the (cheap, possibly absent) allocator stats query."""
+    exporter.observe_rounds(rnd)
+    exporter.set("round", rnd, help_text="current round")
+    exporter.set("rounds_target", eng.cfg.rounds,
+                 help_text="configured total rounds (0 = indefinite)")
+    summ = eng.mstate.get("summary") or {}
+    for key, name in (("val_acc", "val_acc"),
+                      ("poison_acc", "poison_acc"),
+                      ("rounds_per_sec", "rounds_per_sec")):
+        if key in summ:
+            exporter.set(name, summ[key],
+                         help_text=f"last boundary's {key}")
+    for key, value in sup.counters.items():
+        exporter.set(f"supervisor_{key}_total", value, mtype="counter",
+                     help_text="supervisor census "
+                               "(service/supervisor.py)")
+    exporter.set("evals_skipped_total", evals_skipped, mtype="counter",
+                 help_text="eval boundaries skipped by degradation")
+    if ladder is not None:
+        health = ladder.summary()
+        exporter.set("health_incidents_total", health["incidents"],
+                     mtype="counter",
+                     help_text="health incidents (health/monitor.py)")
+        for rung in health_monitor.RUNGS:
+            exporter.set("health_rung_total", health[f"health_{rung}s"],
+                         labels={"rung": rung}, mtype="counter",
+                         help_text="recovery-ladder rung census")
+        exporter.set("health_quarantined", len(health["quarantined"]),
+                     help_text="quarantined client count")
+    if ledger is not None:
+        exporter.set("ledger_seq", ledger.seq,
+                     help_text="event-ledger sequence number "
+                               "(obs/events.py)")
+    for key, value in obs_attribution.memory_watermarks().items():
+        exporter.set(key, value,
+                     help_text="device allocator watermark (bytes)")
+    exporter.flush()
 
 
 def _emit_service_rows(eng, sup: Supervisor, evals_skipped: int,

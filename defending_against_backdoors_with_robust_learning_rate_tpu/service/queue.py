@@ -49,6 +49,8 @@ from typing import Any, Dict, List, Optional
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
     Config, args_parser)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    events as obs_events)
 
 SUMMARY_KEYS = ("round", "val_acc", "val_loss", "poison_acc", "poison_loss",
                 "rounds_per_sec", "steady_rounds_per_sec", "params",
@@ -271,6 +273,14 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
               f"cells")
     else:
         items = [("serial", [cell]) for cell in cells]
+    # queue-level event ledger (obs/events.py): cell/pack lifecycle as
+    # typed records at the log root — NOT installed as the ambient
+    # ledger (a service-mode cell's serve installs its own per-run one)
+    qledger = None
+    if base_cfg.events == "on":
+        qledger = obs_events.EventLedger(
+            os.path.join(base_cfg.log_dir, "events.jsonl"), run="queue",
+            corr=obs_events.corr_id(f"queue:{results_path}"))
     rows: List[Dict[str, Any]] = []
     t_queue = time.perf_counter()
     with open(results_path, "a", encoding="utf-8") as out:
@@ -278,21 +288,62 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
             if kind == "pack":
                 print(f"[queue] tenant pack x{len(group)}: "
                       f"{[c['name'] for c in group]}")
+                if qledger is not None:
+                    qledger.emit("queue/pack_start", tenants=len(group),
+                                 cells=[c["name"] for c in group])
                 new_rows = _run_pack_cells(base_cfg, group)
+                if qledger is not None and not any(
+                        "tenancy" in r for r in new_rows):
+                    qledger.emit("queue/pack_fallback", severity="warn",
+                                 cells=[c["name"] for c in group],
+                                 note="pack degraded to serial (or "
+                                      "failed) — see cell rows")
             else:
                 cell = group[0]
                 print(f"[queue] cell {len(rows) + 1}/{len(cells)} "
                       f"{cell['name']!r}: {cell['overrides']}")
+                if qledger is not None:
+                    qledger.emit("queue/cell_start", cell=cell["name"])
                 new_rows = [_run_serial_cell(base_cfg, cell,
                                              service_mode)]
             for row in new_rows:
                 out.write(json.dumps(row) + "\n")
                 out.flush()   # a mid-queue kill keeps completed rows
                 rows.append(row)
+                if qledger is None:
+                    continue
+                slot = (row.get("tenancy") or {}).get("slot")
+                if row.get("ok"):
+                    qledger.emit("queue/cell_done", cell=row["cell"],
+                                 slot=slot, wall_s=row.get("wall_s"))
+                else:
+                    qledger.emit("queue/cell_fail", severity="error",
+                                 cell=row["cell"], slot=slot,
+                                 error=row.get("error"))
         summary_row = _queue_summary_row(
             rows, time.perf_counter() - t_queue)
         out.write(json.dumps(summary_row) + "\n")
         out.flush()
+    if qledger is not None:
+        qledger.emit("queue/done", cells=summary_row["cells"],
+                     ok=summary_row["ok"],
+                     cells_per_hour=summary_row["cells_per_hour"])
+        qledger.close()
+    if base_cfg.metrics_textfile:
+        # queue-level scrape state: cells/hour + completion census in
+        # the same textfile-collector format the service exporter uses
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            export as obs_export)
+        qexp = obs_export.MetricsExporter(
+            textfile=base_cfg.metrics_textfile,
+            info={"queue": results_path})
+        qexp.set("queue_cells_total", summary_row["cells"],
+                 mtype="counter", help_text="queue cells attempted")
+        qexp.set("queue_cells_ok_total", summary_row["ok"],
+                 mtype="counter", help_text="queue cells completed ok")
+        qexp.set("queue_cells_per_hour", summary_row["cells_per_hour"],
+                 help_text="queue throughput")
+        qexp.close()
     done = sum(r["ok"] for r in rows)
     print(f"[queue] {done}/{len(rows)} cells completed "
           f"({summary_row['cells_per_hour']} cells/hour) "

@@ -36,7 +36,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
-    heartbeat as hb_mod)
+    events as obs_events, heartbeat as hb_mod)
 
 # substrings that mark an error retry-worthy: the gRPC/absl status names
 # XLA:TPU runtime errors carry, plus the socket-level strings a wedged
@@ -143,6 +143,11 @@ class Supervisor:
                 if cls not in RETRYABLE or attempt >= self.retries:
                     self.counters["gave_up"] += 1
                     self.phase("degraded", failed_kind=kind)
+                    obs_events.emit("supervisor/give_up", severity="error",
+                                    round=unit if isinstance(unit, int)
+                                    else None,
+                                    kind=kind, classification=cls,
+                                    attempts=attempt + 1)
                     raise UnitFailure(kind, unit, cls, attempt + 1, e) \
                         from e
                 delay = self.backoff(attempt)
@@ -151,6 +156,14 @@ class Supervisor:
                 print(f"[service] {kind} unit {unit}: {cls} failure "
                       f"({type(e).__name__}: {e}); retry "
                       f"{attempt}/{self.retries} after {delay:.2f}s")
+                # one typed ledger record per retry: backoff_s is the
+                # deterministic schedule value, not measured time, so the
+                # record joins the twin-drill byte comparison
+                obs_events.emit("supervisor/retry", severity="warn",
+                                round=unit if isinstance(unit, int)
+                                else None,
+                                kind=kind, classification=cls,
+                                attempt=attempt, backoff_s=delay)
                 self.phase("retry", retry_kind=kind)
                 self.phase("backoff", retry_kind=kind)
                 self._sleep(delay)
@@ -164,6 +177,9 @@ class Supervisor:
                 print(f"[service] {kind} unit {unit}: completed but took "
                       f"{elapsed:.2f}s (deadline {self.deadline_s:.2f}s) "
                       f"— flagged wedged-slow")
+                obs_events.emit("supervisor/slow", severity="warn",
+                                round=unit if isinstance(unit, int)
+                                else None, kind=kind)
                 self.phase("slow", slow_kind=kind)
             return out
 

@@ -47,7 +47,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     monitor as health_monitor, sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
-    telemetry as obs_telemetry)
+    events as obs_events, telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -802,6 +802,10 @@ class RoundEngine:
                     if entry["round"] == start_round:
                         health_ema = entry.get("health") or None
                 print(f"[ckpt] resumed from round {start_round}")
+                # a per-life record (obs/events.PER_LIFE_PREFIXES): each
+                # process/segment that restores emits its own — a no-op
+                # outside the service plane (no ledger installed)
+                obs_events.emit("checkpoint/restore", round=start_round)
 
         # --- AOT adoption: swap jitted program families for banked
         # serialized executables (utils/compile_cache.py). A warm start
@@ -1359,6 +1363,12 @@ class RoundEngine:
             ckpt.save(cfg.checkpoint_dir, rnd, self.params, self.base_key,
                       self.mstate["cum_poison_acc"], self.cum_net_mov,
                       keep_last=keep)
+        # replay-deduped (obs/events.REPLAY_DEDUPE_EVENTS): a crash-exact
+        # resume that re-saves an already-ledgered boundary re-emits
+        # nothing, so interrupted and uninterrupted twins stay
+        # byte-identical; emitted BEFORE the journal write so a kill in
+        # between leaves the dedupe mark, not a missing record
+        obs_events.emit("checkpoint/save", round=rnd)
         if journal:
             offset = getattr(self.writer, "offset", None)
             if offset is not None:

@@ -250,6 +250,12 @@ def restore(ckpt_dir: str, params_like, upto: Optional[int] = None,
             print(f"[ckpt] round_{rnd:06d}: digest mismatch "
                   f"(truncated/corrupt checkpoint) — falling back to the "
                   f"previous one")
+            # lazy import: this module is imported by stdlib-side tools
+            # and must not pull the obs package at module-import time
+            from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                events as obs_events)
+            obs_events.emit("checkpoint/digest_fallback",
+                            severity="error", round=rnd)
             continue
         state, key = _restore_state(_round_path(ckpt_dir, rnd), params_like)
         return (int(state["round"]), state["params"], key,

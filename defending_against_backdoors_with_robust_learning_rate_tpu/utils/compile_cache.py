@@ -89,6 +89,8 @@ EXCLUDED_FIELDS = frozenset({
     # obs/: spans + heartbeat are host-side IO; `telemetry` is NOT here —
     # it adds outputs to the traced program, so it must key the cache
     "spans", "heartbeat", "status_file",
+    # fleet observability (ISSUE 15): ledger + exporter are host-side IO
+    "events", "metrics_port", "metrics_textfile",
     # fingerprint-drift fixes (ISSUE 4 audit): runtime-only fields that
     # used to split identical programs across cache keys. `platform`
     # (backend is fingerprinted directly), the multihost rendezvous
@@ -415,12 +417,15 @@ class AotBank:
         ("Symbols not found" at deserialize) — the bank must hold
         self-contained executables. A verify-load after save catches any
         other unserializable case and deletes the broken artifacts."""
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            events as obs_events)
         fp = fingerprint(cfg, family, example_args)
         entry = self.lookup(family, fp)
         if entry is not None:
             t0 = time.perf_counter()
             compiled = self.load(family, fp)
             if compiled is not None:
+                obs_events.emit("aot/hit", family=family)
                 return compiled, True, time.perf_counter() - t0, entry
         xla_cache_dir = jax.config.jax_compilation_cache_dir
         t0 = time.perf_counter()
@@ -450,6 +455,7 @@ class AotBank:
             entry = {"family": family, "fingerprint": fp,
                      "compile_s": round(secs, 2),
                      "unserializable": f"{type(e).__name__}: {e}"}
+        obs_events.emit("aot/miss", family=family)
         return compiled, False, secs, entry
 
     def entries(self) -> List[Dict[str, Any]]:

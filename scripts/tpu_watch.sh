@@ -31,13 +31,20 @@ except Exception:
 age = time.time() - float(s.get("updated_at", 0))
 budget = 3600 if s.get("compile_in_flight") else 600
 alive = os.path.exists("/proc/%d" % int(s.get("pid", 0)))
+# fleet-obs fields (obs/events.py via the heartbeat): a run whose phase
+# advances while ledger_seq freezes has a wedged ledger — surfaced here
+# for the log line; the console (obs/console.py) does the real judging
+last = s.get("last_event") or {}
+print("phase=%s round=%s ledger_seq=%s last_event=%s@%s"
+      % (s.get("phase"), s.get("round"), s.get("ledger_seq"),
+         last.get("event"), last.get("round")))
 sys.exit(0 if alive and age < budget else 1)
 PY
 }
 
 for i in $(seq 1 70); do
-  if status_live; then
-    echo "[watcher] probe $i: live heartbeat in $STATUS at $(date) — an active run owns the TPU; deferring" >>"$W"
+  if INFO=$(status_live); then
+    echo "[watcher] probe $i: live heartbeat in $STATUS at $(date) ($INFO) — an active run owns the TPU; deferring" >>"$W"
     sleep 520
     continue
   fi

@@ -330,7 +330,9 @@ SVC = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
              poison_frac=1.0, robustLR_threshold=3,
              service_backoff_s=0.01)
 
-EXCLUDE = ("Throughput/", "Service/", "Spans/", "Memory/", "_run/")
+# single source (ISSUE 15 satellite): obs/constants.py owns the list
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs.constants import (  # noqa: E402
+    NON_TIMING_PREFIXES as EXCLUDE)
 
 
 @pytest.fixture(scope="module")

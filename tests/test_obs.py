@@ -452,9 +452,11 @@ def test_driver_profile_rounds_window_report_and_off_bit_identity(
     assert not any(t.startswith("Device/") for t in off_tags)
 
     def value_rows(d):
-        skip = ("Spans/", "Throughput/", "Device/", "Memory/", "_run/")
+        # single source (ISSUE 15 satellite): obs/constants.py
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs.constants import (
+            NON_TIMING_PREFIXES)
         return [r for r in _tags(os.path.join(d, "metrics.jsonl"))
-                if not any(r["tag"].startswith(p) for p in skip)]
+                if not r["tag"].startswith(NON_TIMING_PREFIXES)]
 
     prof_rows = value_rows(run_dir)
     assert prof_rows == value_rows(off_dir) and len(prof_rows) >= 2 * 7

@@ -492,7 +492,10 @@ SVC = Config(data="synthetic", num_agents=8, bs=16, local_ep=1,
              churn_available=0.75, churn_period=3,
              service_backoff_s=0.01)
 
-EXCLUDE = ("Throughput/", "Service/", "Spans/", "Memory/", "_run/")
+# single source (ISSUE 15 satellite): the exclusion list lives in
+# obs/constants.py — it drifted once per PR while hand-duplicated here
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs.constants import (  # noqa: E402
+    NON_TIMING_PREFIXES as EXCLUDE)
 
 
 @pytest.fixture(scope="module")
