@@ -155,6 +155,9 @@ DONATED_FAMILIES: Tuple[str, ...] = (
     "chained_async", "chained_async_mb", "chained_cohort_async",
     "chained_cohort_async_mb", "chained_sharded_async",
     "chained_sharded_async_mb",
+    # buffered tenant packs (ISSUE 16): the chained scan donates the
+    # [E]-stacked (params, buffer) carry
+    "chained_async_mt", "chained_async_mb_mt",
 )
 
 # --------------------------------------------------------------------------
@@ -670,6 +673,43 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         sharded=True, cfg_overrides={**mt, "agg_layout": "bucket"},
         collective_budget=dict(rs_budget),
         hlo_all_reduce_max=2 + spmd_overhead)
+
+    # buffered tenant packs (ISSUE 16): the carried (params, buffer)
+    # state stacks as a leading [E] axis and the async fold batches over
+    # tenants under the vmap — the contribution sums still ride the sync
+    # plan's collectives (per-leaf psums of [E, S+1, ...] payloads, one
+    # packed lane psum), so the claim is the async budget UNCHANGED by
+    # the tenant axis at 1/8/16-way: vmap collective-free, leaf avg+RLR
+    # within 2L+2 psums, sign+RLR within L+1, the bucket layout keeps
+    # its 4-collective reduce-scatter shape. The cohort-tenant twin pins
+    # gap 3 (one shared bank gather per round): the in-program cohort
+    # draw batches over tenants collective-free.
+    buf_mt = {**buf, **mt}
+    specs["vmap_rlr_avg_async_mt"] = CheckSpec(
+        name="vmap_rlr_avg_async_mt", family="round_async_mt",
+        sharded=False, cfg_overrides=dict(buf_mt),
+        collective_budget=dict(zero))
+    specs["sharded_rlr_avg_async_mt"] = CheckSpec(
+        name="sharded_rlr_avg_async_mt", family="round_sharded_async_mt",
+        sharded=True, cfg_overrides=dict(buf_mt),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_sign_async_mt"] = CheckSpec(
+        name="sharded_rlr_sign_async_mt",
+        family="round_sharded_async_mt", sharded=True,
+        cfg_overrides={**buf_mt, "aggr": "sign", "server_lr": 1.0},
+        collective_budget={**zero, "psum": n_leaves + 1},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_async_mt"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_async_mt",
+        family="round_sharded_async_mt", sharded=True,
+        cfg_overrides={**buf_mt, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["vmap_rlr_avg_cohort_mt"] = CheckSpec(
+        name="vmap_rlr_avg_cohort_mt", family="round_cohort_mt",
+        sharded=False, cfg_overrides={**coh, **mt},
+        collective_budget=dict(zero))
 
     # in-program health lane + quarantine mask (ISSUE 14, health/): the
     # sentinel is pure jnp reductions on data the body already holds, and

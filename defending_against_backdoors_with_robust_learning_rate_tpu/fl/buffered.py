@@ -380,7 +380,7 @@ def _roll_pend(pend, contrib_tail):
         pend, contrib_tail)
 
 
-def fold_commit(cfg, params, state, contribs, k_noise, m):
+def fold_commit(cfg, params, state, contribs, k_noise, m, knobs=None):
     """Fold one tick's (global) contributions into the carried buffer,
     commit when the gate fires, return the advanced carry.
 
@@ -393,7 +393,15 @@ def fold_commit(cfg, params, state, contribs, k_noise, m):
     plus (per-bin state present) the per-staleness Defense split, and
     ``vote_sign`` the buffer's accumulated sign-sum tree (None without a
     vote) — handed to telemetry so the margin histogram describes the
-    BUFFERED electorate without issuing any collective of its own."""
+    BUFFERED electorate without issuing any collective of its own.
+
+    ``knobs`` (fl/tenancy.TenantKnobs — this tenant's slice of the
+    pack's traced [E]-vectors) overrides the server-LR and RLR-threshold
+    scalars the solo paths bake in as Python constants; the STRUCTURAL
+    decisions (is the vote built, is the threshold scaled) stay on
+    ``cfg``, which the pack canonicalizes (fl/tenancy.canonical_rep
+    collapses thresholds to the 0/1 vote bit) — everything the overrides
+    touch is elementwise, so the collective plan is knob-free."""
     S = max_staleness(cfg)
     avg = cfg.aggr == "avg"
     sgn = wants_sign(cfg)
@@ -478,8 +486,10 @@ def fold_commit(cfg, params, state, contribs, k_noise, m):
     # compiled program serves every fill level)
     K = buffer_k(cfg)
     commit = count1 >= K
-    slr = cfg.effective_server_lr
-    thr = float(cfg.robustLR_threshold)
+    slr = (cfg.effective_server_lr if knobs is None
+           else knobs.server_lr)
+    thr = (float(cfg.robustLR_threshold) if knobs is None
+           else knobs.rlr_threshold)
     if cfg.robustLR_threshold > 0 and cfg.rlr_threshold_mode == "scaled":
         # the buffered electorate is the buffer, not the cohort: scale
         # against the arrivals actually voting

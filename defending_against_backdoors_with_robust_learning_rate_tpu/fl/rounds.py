@@ -324,7 +324,7 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                                                    mask, T)
             new_params, new_astate, lr, agg, a_extras, vote_sign = \
                 buffered.fold_commit(cfg, params, astate, contribs,
-                                     k_noise, m)
+                                     k_noise, m, knobs=knobs)
         extras.update(a_extras)
         if cfg.telemetry != "off":
             from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (

@@ -73,19 +73,33 @@ class TenantKnobs(NamedTuple):
     """The traced per-tenant scalar knobs, one [E]-vector per field (a
     scalar per field inside the tenant vmap). A NamedTuple so it is a
     pytree with a FIXED structure — the AOT fingerprint's arg avals stay
-    stable across packs of the same width."""
+    stable across packs of the same width.
+
+    ``rnd_offset`` is not a Config field: it is the scheduler's slot
+    clock (service/scheduler.py). A cell backfilled into slot e at pack
+    round p runs with offset -p, so its EFFECTIVE round index
+    (rnd + offset) counts 1..rounds exactly like its solo twin — key
+    folds, churn lifecycle and attack schedules all consume the
+    effective index, keeping backfilled streams solo-exact. Every
+    FIFO-path pack runs offset 0, which is arithmetically the historical
+    program."""
     server_lr: jnp.ndarray      # [E] f32, the EFFECTIVE server lr
     rlr_threshold: jnp.ndarray  # [E] f32 (0 = undefended tenant)
     attack_boost: jnp.ndarray   # [E] f32
     attack_start: jnp.ndarray   # [E] i32
     attack_stop: jnp.ndarray    # [E] i32
     attack_every: jnp.ndarray   # [E] i32
+    rnd_offset: jnp.ndarray     # [E] i32, slot clock (0 = pack clock)
 
 
-def knob_vectors(cells) -> TenantKnobs:
+def knob_vectors(cells, rnd_offsets=None) -> TenantKnobs:
     """Stack the E cell configs' scalar knobs into the traced vectors.
     The aggr=='sign' server-LR rule (config.effective_server_lr) is
-    resolved here, per tenant, host-side."""
+    resolved here, per tenant, host-side. ``rnd_offsets`` is the
+    scheduler's per-slot clock skew (None = the FIFO pack's zeros)."""
+    E = len(cells)
+    if rnd_offsets is None:
+        rnd_offsets = [0] * E
     return TenantKnobs(
         server_lr=np.asarray([c.effective_server_lr for c in cells],
                              np.float32),
@@ -96,6 +110,7 @@ def knob_vectors(cells) -> TenantKnobs:
         attack_start=np.asarray([c.attack_start for c in cells], np.int32),
         attack_stop=np.asarray([c.attack_stop for c in cells], np.int32),
         attack_every=np.asarray([c.attack_every for c in cells], np.int32),
+        rnd_offset=np.asarray(rnd_offsets, np.int32),
     )
 
 
@@ -105,7 +120,8 @@ def knob_avals(E: int) -> TenantKnobs:
     i32 = lambda: jax.ShapeDtypeStruct((E,), jnp.int32)    # noqa: E731
     return TenantKnobs(server_lr=f32(), rlr_threshold=f32(),
                        attack_boost=f32(), attack_start=i32(),
-                       attack_stop=i32(), attack_every=i32())
+                       attack_stop=i32(), attack_every=i32(),
+                       rnd_offset=i32())
 
 
 def canonical_rep(cfg, cells=None):
@@ -146,10 +162,6 @@ def ineligible_reason(cfg) -> str:
     the queue's routing (service/tenancy.serial_reason) — this module is
     in the fingerprint audit's program-read scope and only consults
     program-tagged fields."""
-    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
-        buffered)
-    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
-        compile_cache)
     if cfg.diagnostics:
         return ("--diagnostics needs the per-tenant research scalars the "
                 "pack never materializes; run those cells solo")
@@ -159,13 +171,14 @@ def ineligible_reason(cfg) -> str:
                 "run pallas cells solo")
     if cfg.debug_nan:
         return "--debug_nan (checkify) runs solo"
-    if buffered.is_buffered(cfg):
-        return ("--agg_mode buffered carries per-run buffer state the "
-                "pack does not stack yet (ROADMAP); run buffered cells "
-                "solo")
-    if compile_cache.is_cohort_mode(cfg):
-        return ("cohort-sampled mode is not tenant-packed yet (the bank "
-                "gather is per-run); run cohort cells solo")
+    # buffered (agg_mode) packs stack the carried (params, state) buffer
+    # as a leading [E] axis (ISSUE 16); cohort-sampled packs share ONE
+    # bank gather across tenants (the cohort draw is cohort_seed-driven,
+    # identical for every tenant at the same effective round) — both are
+    # pack-eligible now. The cohort constraint — rnd_offset must be 0 so
+    # the shared draw stays shared — is a SCHEDULER admission rule
+    # (service/scheduler.py never backfills a cohort pack mid-run), not a
+    # program refusal.
     return ""
 
 
@@ -173,18 +186,32 @@ def ineligible_reason(cfg) -> str:
 
 def make_tenant_step(cfg, model, normalize):
     """The per-tenant solo body the tenant vmap batches:
-    step(params, key, rnd, knobs, images, labels, sizes) ->
-    (params, info). Identical ops and key derivation as
+    step(carry, key, rnd, knobs, images, labels, sizes) ->
+    (carry, info). Identical ops and key derivation as
     fl/rounds._make_sample_step's body — that is what makes per-tenant
     results ulp-close to solo runs — with the scalar knobs arriving
     traced instead of baked (fl/rounds._round_core `knobs`). Always takes
     the round index: the churn lifecycle and the per-tenant schedule
-    gates consume it, and an unused lead argument is free."""
+    gates consume it, and an unused lead argument is free.
+
+    Two ISSUE-16 extensions, both no-ops on the historical path:
+    * the tenant runs on its EFFECTIVE clock rnd + knobs.rnd_offset —
+      churn lifecycle and attack schedule gates see the tenant's own
+      round index, so a cell backfilled mid-pack is solo-exact
+      (offset 0 is arithmetically the old program);
+    * buffered mode carries (params, buffer state) as the step carry —
+      fold_commit consumes the per-tenant knobs, and the vmapped carry
+      stacks both halves along the tenant axis."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+        buffered)
     train_block = make_block_trainer(model, cfg, normalize)
     K, m = cfg.num_agents, cfg.agents_per_round
     want_flags = host_takes_flags(cfg)
+    is_async = buffered.is_buffered(cfg)
 
-    def step(params, key, rnd, knobs, images, labels, sizes):
+    def step(carry, key, rnd, knobs, images, labels, sizes):
+        params, astate = carry if is_async else (carry, None)
+        rnd = rnd + knobs.rnd_offset  # the tenant's own round index
         k_sample, k_train, k_noise = jax.random.split(key, 3)
         with jax.named_scope("sample_gather"):
             sampled = jax.random.permutation(k_sample, K)[:m]
@@ -197,12 +224,17 @@ def make_tenant_step(cfg, model, normalize):
                 churn as churn_mod)
             with jax.named_scope("churn_mask"):
                 churn_active = churn_mod.active_slots(cfg, sampled, rnd)
-        new_params, train_loss, extras = _round_core(
+        result = _round_core(
             params, k_train, k_noise, imgs, lbls, szs,
             train_block=train_block, cfg=cfg,
             corrupt_flags=(sampled < cfg.num_corrupt
                            if want_flags else None),
-            churn_active=churn_active, rnd=rnd, knobs=knobs)
+            churn_active=churn_active, rnd=rnd, astate=astate, knobs=knobs)
+        if is_async:
+            new_params, train_loss, extras, new_astate = result
+            return (new_params, new_astate), {
+                "train_loss": train_loss, "sampled": sampled, **extras}
+        new_params, train_loss, extras = result
         return new_params, {"train_loss": train_loss, "sampled": sampled,
                             **extras}
 
@@ -237,10 +269,12 @@ def make_tenant_chained_fn(cfg, model, normalize, images, labels, sizes):
     """Tenant-pack chained block:
     chained(params_E, base_keys_E, round_ids, knobs) — a `lax.scan` over
     rounds of the tenant-vmapped body; round r's per-tenant key is
-    `fold_in(base_key_e, r)`, the driver loop's exact derivation, so a
-    chained pack matches dispatching the same pack rounds one at a time.
-    params_E is donated (the chained-family contract,
-    analysis/contracts.DONATED_FAMILIES)."""
+    `fold_in(base_key_e, r + rnd_offset_e)`, the driver loop's exact
+    derivation at the tenant's EFFECTIVE round, so a chained pack matches
+    dispatching the same pack rounds one at a time (and a backfilled
+    tenant's key stream matches its solo twin). The carry — params_E, or
+    (params_E, astate_E) in buffered mode — is donated (the
+    chained-family contract, analysis/contracts.DONATED_FAMILIES)."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
     vstep = _vmap_step(make_tenant_step(cfg, model, normalize))
@@ -249,8 +283,9 @@ def make_tenant_chained_fn(cfg, model, normalize, images, labels, sizes):
     def chained(params_E, base_keys_E, round_ids, knobs,
                 images, labels, sizes):
         def body(params_E, rnd):
-            keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                base_keys_E, rnd)
+            keys = jax.vmap(
+                lambda k, off: jax.random.fold_in(k, rnd + off))(
+                base_keys_E, knobs.rnd_offset)
             new_params, info = vstep(params_E, keys, rnd, knobs,
                                      images, labels, sizes)
             out = {"train_loss": info["train_loss"],
@@ -272,6 +307,77 @@ def make_tenant_chained_fn(cfg, model, normalize, images, labels, sizes):
 
     bound.jitted, bound.data = chained, (images, labels, sizes)
     bound.family = "chained" + compile_cache.family_suffix(cfg)
+    return bound
+
+
+def make_tenant_cohort_step(cfg, model, normalize):
+    """Per-tenant cohort-sampled body the tenant vmap batches:
+    step(carry, key, rnd, knobs, imgs, lbls, sizes) -> (carry, info) —
+    fl/rounds.make_cohort_step with the knobs traced (ISSUE 16 gap 3).
+
+    Data arrives as the SHARED [m, ...] cohort stacks, host-gathered ONCE
+    per round for the whole pack (vmap broadcasts them): the cohort draw
+    (data/cohort.sample_cohort) is cohort_seed-driven — NOT a knob field —
+    so every tenant at the same effective round draws the same ids, and
+    one indexed bank gather on the prefetch thread serves all E tenants.
+    That is also why cohort packs admit no mid-run backfill: a nonzero
+    rnd_offset would skew one tenant's draw away from the shared gather
+    (service/scheduler.py pins cohort-pack offsets to 0; the in-program
+    draw still consumes the effective round so the invariant is 'offsets
+    equal', degrading loudly in parity tests rather than silently)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+        cohort as cohort_mod)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+        buffered)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
+        sentinel as health_sentinel)
+    train_block = make_block_trainer(model, cfg, normalize)
+    want_flags = host_takes_flags(cfg)
+    is_async = buffered.is_buffered(cfg)
+
+    def step(carry, key, rnd, knobs, imgs, lbls, sizes):
+        params, astate = carry if is_async else (carry, None)
+        rnd = rnd + knobs.rnd_offset
+        with jax.named_scope("cohort_sample"):
+            ids, active = cohort_mod.sample_cohort(cfg, rnd)
+        if health_sentinel.has_quarantine(cfg):
+            active = active & health_sentinel.quarantine_mask(cfg, ids)
+        k_train, k_noise = jax.random.split(key)
+        res = _round_core(
+            params, k_train, k_noise, imgs, lbls, sizes,
+            train_block=train_block, cfg=cfg,
+            corrupt_flags=((ids < cfg.num_corrupt) & active
+                           if want_flags else None),
+            churn_active=active, rnd=rnd, astate=astate, knobs=knobs)
+        if is_async:
+            new_params, train_loss, extras, new_astate = res
+            return ((new_params, new_astate),
+                    {"train_loss": train_loss, "sampled": ids, **extras})
+        new_params, train_loss, extras = res
+        return new_params, {"train_loss": train_loss, "sampled": ids,
+                            **extras}
+
+    step.takes_round = True
+    return step
+
+
+def make_tenant_cohort_round_fn(cfg, model, normalize):
+    """Tenant-pack cohort round fn:
+    round(carry_E, keys_E, rnd, knobs, imgs, lbls, sizes) with the
+    cohort stacks broadcast across tenants (gathered once per round by
+    the engine's prefetch thread). Data is NOT bound here — cohort rows
+    change every round, so they stay call-time arguments exactly like the
+    solo cohort path."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
+        compile_cache)
+    vstep = jax.jit(_vmap_step(make_tenant_cohort_step(cfg, model,
+                                                       normalize)))
+
+    def bound(carry_E, keys_E, rnd, knobs, imgs, lbls, sizes):
+        return vstep(carry_E, keys_E, rnd, knobs, imgs, lbls, sizes)
+
+    bound.jitted = vstep
+    bound.family = "round_cohort" + compile_cache.family_suffix(cfg)
     return bound
 
 

@@ -32,6 +32,13 @@ from typing import Any, Dict, List, Optional, Tuple
 DEFAULT_TOLERANCE = 0.15
 VERSION = 1
 
+# judged metrics and the point key each stores its value under; fleet
+# artifacts (service/queue.py --scheduler writes fleet_bench.json) join
+# the same series in their own comparability group — a fleet cells/hour
+# number is never compared against a solo rounds/sec flagship
+METRICS = {"fl_rounds_per_sec": "rounds_per_sec",
+           "fleet_cells_per_hour": "cells_per_hour"}
+
 
 class MalformedArtifact(ValueError):
     """A file that is neither a session bench record nor a bench result
@@ -72,22 +79,40 @@ def parse_artifact(path: str) -> Dict[str, Any]:
             f"{path}: neither a bench result (no 'metric') nor a "
             f"session record (no 'cmd'/'rc')")
     if rc != 0 or not isinstance(parsed, dict) \
-            or parsed.get("metric") != "fl_rounds_per_sec" \
+            or parsed.get("metric") not in METRICS \
             or "value" not in parsed:
         return {"label": label, "source": source, "ok": False,
                 "note": (f"bench rc {rc}" if rc else "no parsed metric")}
+    metric = parsed["metric"]
+    group = _group_key(parsed)
+    if metric == "fleet_cells_per_hour":
+        group = f"fleet_{group}"
     point = {
         "label": label, "source": source, "ok": True,
-        "rounds_per_sec": float(parsed["value"]),
-        "group": _group_key(parsed),
+        "metric": metric,
+        METRICS[metric]: float(parsed["value"]),
+        "group": group,
         "device": parsed.get("device"),
     }
     for key in ("mfu", "tflops_per_sec", "tflop_per_round", "compile_s",
                 "chain", "vs_baseline", "dtype", "bench_config",
-                "reduced_shapes", "backend_note"):
+                "reduced_shapes", "backend_note", "slot_occupancy",
+                "cells", "scheduler_bins", "wall_s"):
         if key in parsed:
             point[key] = parsed[key]
     return point
+
+
+def point_value(point: Dict[str, Any]) -> float:
+    """The judged value of an ok point, whichever metric it carries
+    (committed pre-fleet points have no 'metric' field and store
+    rounds_per_sec — the historical schema stays readable)."""
+    for key in METRICS.values():
+        if key in point:
+            return float(point[key])
+    raise MalformedArtifact(
+        f"point {point.get('label')!r} has no judged value "
+        f"(expected one of {sorted(METRICS.values())})")
 
 
 # --------------------------------------------------------------------------
@@ -153,7 +178,7 @@ def judge(traj: Dict[str, Any]) -> Tuple[List[Dict[str, Any]], bool]:
                                               "recorded failure")})
             continue
         group = point["group"]
-        value = float(point["rounds_per_sec"])
+        value = point_value(point)
         prev = best.get(group)
         if prev is None:
             results.append({"label": point["label"], "group": group,

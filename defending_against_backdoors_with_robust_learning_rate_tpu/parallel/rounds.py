@@ -628,10 +628,6 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     # schedule's traced gate is always-on); solo bodies only take the
     # gate input when a schedule actually needs the round index
     atk_sched = (atk_on if mt else attack_registry.needs_round(cfg))
-    if mt and buffered.is_buffered(cfg):
-        raise ValueError(
-            "--agg_mode buffered is not tenant-packed yet (the carried "
-            "buffer state is per-run); run buffered cells solo")
     if take_flags is None:
         take_flags = host_takes_flags(cfg)
     if faults_on:
@@ -778,7 +774,7 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                 loss = (packed[-3] if h_on else packed[-1]) / d
                 new_params, new_astate, lr, agg, a_extras, vote_sign = \
                     buffered.fold_commit(cfg, params, astate, contribs,
-                                         noise_key, m)
+                                         noise_key, m, knobs=knobs)
             extras = dict(a_extras)
             if h_on:
                 with jax.named_scope("health"):
@@ -1019,7 +1015,11 @@ def make_sharded_round_fn_mt(cfg, model, normalize, mesh,
     instead of multiplying — the *_mt CheckSpecs pin the unchanged plan
     at 1/8/16-way). Per-tenant sampling, corrupt flags, churn masks and
     schedule gates are computed OUTSIDE shard_map from the per-tenant
-    keys/knobs and enter replicated, the solo body's exact discipline."""
+    keys/knobs and enter replicated, the solo body's exact discipline.
+    Buffered mode carries (params_E, astate_E) — both [E]-stacked,
+    replicated across the mesh like the solo sharded-async carry — and
+    each tenant runs on its EFFECTIVE clock rnd + knobs.rnd_offset (the
+    scheduler's backfill skew; 0 on the FIFO path)."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
         registry as attack_registry, schedule as attack_schedule)
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
@@ -1031,7 +1031,9 @@ def make_sharded_round_fn_mt(cfg, model, normalize, mesh,
     want_flags = host_takes_flags(cfg)
     atk_gated = attack_registry.in_jit(cfg)
 
-    def step(params_E, keys_E, rnd, knobs, images, labels, sizes):
+    def step(carry_E, keys_E, rnd, knobs, images, labels, sizes):
+        rnd_E = rnd + knobs.rnd_offset  # [E] effective round indices
+
         def sample(key):
             k_sample, k_train, k_noise = jax.random.split(key, 3)
             sampled = jax.random.permutation(k_sample, K)[:m]
@@ -1051,8 +1053,8 @@ def make_sharded_round_fn_mt(cfg, model, normalize, mesh,
                 churn as churn_mod)
             with jax.named_scope("churn_mask"):
                 active_E = jax.vmap(
-                    lambda s: churn_mod.active_slots(cfg, s, rnd))(
-                        sampled_E)
+                    lambda s, r: churn_mod.active_slots(cfg, s, r))(
+                        sampled_E, rnd_E)
         if health_sentinel.has_quarantine(cfg):
             q_E = jax.vmap(
                 lambda s: health_sentinel.quarantine_mask(cfg, s))(
@@ -1062,15 +1064,16 @@ def make_sharded_round_fn_mt(cfg, model, normalize, mesh,
             extra += (active_E,)
         if atk_gated:
             # per-tenant schedule gates from the traced knob triples —
-            # replicated [E] input, zero collectives (the solo gate idiom)
+            # replicated [E] input, zero collectives (the solo gate
+            # idiom); the gate reads each tenant's effective clock
             extra += (attack_schedule.active_traced(
                 knobs.attack_start, knobs.attack_stop,
-                knobs.attack_every, rnd),)
-        new_params, train_loss, extras = sharded(
-            params_E, imgs, lbls, szs, agent_keys_E, k_noise_E,
+                knobs.attack_every, rnd_E),)
+        new_carry, train_loss, extras = sharded(
+            carry_E, imgs, lbls, szs, agent_keys_E, k_noise_E,
             *extra, knobs)
-        return new_params, {"train_loss": train_loss,
-                            "sampled": sampled_E, **extras}
+        return new_carry, {"train_loss": train_loss,
+                           "sampled": sampled_E, **extras}
 
     jitted = jax.jit(step)
 

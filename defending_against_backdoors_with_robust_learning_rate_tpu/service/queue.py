@@ -200,12 +200,17 @@ def _run_pack_cells(base_cfg: Config, pack: List[Dict[str, Any]]
     return rows
 
 
-def _queue_summary_row(rows: List[Dict[str, Any]],
-                       wall_s: float) -> Dict[str, Any]:
+def _queue_summary_row(rows: List[Dict[str, Any]], wall_s: float,
+                       scheduler_stats: Optional[List[Dict[str, Any]]]
+                       = None) -> Dict[str, Any]:
     """The queue-level throughput summary appended as the FINAL
     queue_results.jsonl row: cells/hour, the aggregate wall, and the
     compile-vs-steady split (per-cell steady seconds estimated from each
-    summary's rounds/steady-rate pair; the remainder is compile+warmup)."""
+    summary's rounds/steady-rate pair; the remainder is compile+warmup).
+    A scheduler run (service/scheduler.py) additionally reports the
+    fleet's slot-occupancy fraction: busy slot-dispatches over total
+    slot-dispatches across every bin — the number that says how close
+    the resident fleet came to never idling the chip."""
     ok = [r for r in rows if r.get("ok")]
     steady_s = warmup_s = 0.0
     for r in ok:
@@ -229,7 +234,7 @@ def _queue_summary_row(rows: List[Dict[str, Any]],
         else:
             warmup_s += cell_wall
     packed = sum(1 for r in ok if "tenancy" in r)
-    return {
+    summary = {
         "queue_summary": True,
         "cells": len(rows), "ok": len(ok),
         "packed_cells": packed, "serial_cells": len(ok) - packed,
@@ -242,19 +247,31 @@ def _queue_summary_row(rows: List[Dict[str, Any]],
         "steady_s": round(min(steady_s, wall_s), 3),
         "compile_warmup_s": round(warmup_s, 3),
     }
+    if scheduler_stats:
+        busy = sum(s.get("busy_slot_rounds", 0) for s in scheduler_stats)
+        tot = sum(s.get("total_slot_rounds", 0) for s in scheduler_stats)
+        summary["scheduler"] = True
+        summary["slot_occupancy"] = round(busy / max(tot, 1), 4)
+        summary["scheduler_bins"] = len(scheduler_stats)
+    return summary
 
 
 def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
               results_path: Optional[str] = None,
               service_mode: bool = False,
-              tenants: int = 0) -> List[Dict[str, Any]]:
+              tenants: int = 0,
+              scheduler: bool = False) -> List[Dict[str, Any]]:
     """Run every cell against one AOT bank; returns (and streams) one
     result row per cell, plus a final queue-level throughput summary
     row. ``service_mode`` routes cells through service.driver.serve
     (supervised, journaled) instead of train.run. ``tenants`` E >= 2
     groups shape-compatible cells into tenant packs of up to E run as
     ONE resident *_mt program (service/tenancy.py); incompatible cells
-    fall back to the serial path with a printed note."""
+    fall back to the serial path with a printed note. ``scheduler``
+    (needs tenants >= 2) replaces the fixed FIFO packs with the
+    resident fleet scheduler (service/scheduler.py): capacity-modelled
+    bins whose completed/evicted slots backfill from the queue instead
+    of idling — the serial and FIFO paths stay available for A/B."""
     results_path = results_path or os.path.join(base_cfg.log_dir,
                                                 "queue_results.jsonl")
     os.makedirs(os.path.dirname(results_path) or ".", exist_ok=True)
@@ -262,17 +279,32 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
         print("[queue] --tenants ignored in --service mode (supervised "
               "cells are per-run journaled; packing is one-shot)")
         tenants = 0
-    if tenants >= 2:
+    if scheduler and tenants < 2:
+        print("[queue] --scheduler needs --tenants >= 2 (slots to pack); "
+              "running the serial path")
+        scheduler = False
+    if scheduler:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+            scheduler as fleet)
+        items = fleet.plan_fleet(base_cfg, cells, tenants,
+                                 _apply_overrides)
+        n_bin = sum(1 for kind, _, _ in items if kind == "bin")
+        n_fifo = sum(1 for kind, _, _ in items if kind == "fifo")
+        print(f"[queue] scheduler E={tenants}: {n_bin} bins + {n_fifo} "
+              f"fifo packs + {len(items) - n_bin - n_fifo} serial cells "
+              f"over {len(cells)} cells")
+    elif tenants >= 2:
         from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
             tenancy)
-        items = tenancy.plan_packs(base_cfg, cells, tenants,
-                                   _apply_overrides)
-        n_pack = sum(1 for kind, _ in items if kind == "pack")
+        items = [(kind, group, len(group)) for kind, group in
+                 tenancy.plan_packs(base_cfg, cells, tenants,
+                                    _apply_overrides)]
+        n_pack = sum(1 for kind, _, _ in items if kind == "pack")
         print(f"[queue] tenancy E={tenants}: {n_pack} packs + "
               f"{len(items) - n_pack} serial cells over {len(cells)} "
               f"cells")
     else:
-        items = [("serial", [cell]) for cell in cells]
+        items = [("serial", [cell], 1) for cell in cells]
     # queue-level event ledger (obs/events.py): cell/pack lifecycle as
     # typed records at the log root — NOT installed as the ambient
     # ledger (a service-mode cell's serve installs its own per-run one)
@@ -282,10 +314,36 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
             os.path.join(base_cfg.log_dir, "events.jsonl"), run="queue",
             corr=obs_events.corr_id(f"queue:{results_path}"))
     rows: List[Dict[str, Any]] = []
+    scheduler_stats: List[Dict[str, Any]] = []
     t_queue = time.perf_counter()
     with open(results_path, "a", encoding="utf-8") as out:
-        for kind, group in items:
-            if kind == "pack":
+        for kind, group, width in items:
+            if kind == "bin":
+                from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+                    scheduler as fleet)
+                print(f"[queue] scheduler bin x{len(group)} "
+                      f"(width {width}): {[c['name'] for c in group]}")
+                try:
+                    new_rows, stats = fleet.run_bin(base_cfg, group,
+                                                    width,
+                                                    qledger=qledger)
+                    scheduler_stats.append(stats)
+                except Exception as e:
+                    # a bin that dies before its engine exists (e.g.
+                    # dataset load) degrades to the serial path — the
+                    # FIFO queue's pack-fallback contract, bin-shaped
+                    print(f"[queue] scheduler bin FAILED "
+                          f"({type(e).__name__}: {e}) — running "
+                          f"members serially")
+                    if qledger is not None:
+                        qledger.emit("queue/pack_fallback",
+                                     severity="warn",
+                                     cells=[c["name"] for c in group],
+                                     note=f"{type(e).__name__}: {e}")
+                    new_rows = [_run_serial_cell(base_cfg, c,
+                                                 service_mode)
+                                for c in group]
+            elif kind in ("pack", "fifo"):
                 print(f"[queue] tenant pack x{len(group)}: "
                       f"{[c['name'] for c in group]}")
                 if qledger is not None:
@@ -321,7 +379,8 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
                                  cell=row["cell"], slot=slot,
                                  error=row.get("error"))
         summary_row = _queue_summary_row(
-            rows, time.perf_counter() - t_queue)
+            rows, time.perf_counter() - t_queue,
+            scheduler_stats=scheduler_stats or None)
         out.write(json.dumps(summary_row) + "\n")
         out.flush()
     if qledger is not None:
@@ -343,7 +402,39 @@ def run_queue(base_cfg: Config, cells: List[Dict[str, Any]],
                  mtype="counter", help_text="queue cells completed ok")
         qexp.set("queue_cells_per_hour", summary_row["cells_per_hour"],
                  help_text="queue throughput")
+        if "slot_occupancy" in summary_row:
+            # fleet-level scheduler gauges (service/scheduler.py): the
+            # same cells/hour number the `fleet` trajectory group gates
+            qexp.set("fleet_cells_per_hour",
+                     summary_row["cells_per_hour"],
+                     help_text="resident fleet throughput (scheduler)")
+            qexp.set("fleet_slot_occupancy",
+                     summary_row["slot_occupancy"],
+                     help_text="busy slot-dispatches / total "
+                               "slot-dispatches across scheduler bins")
         qexp.close()
+    if "slot_occupancy" in summary_row:
+        # fleet bench artifact: a bare bench-result object the perf
+        # trajectory gate folds into its `fleet` comparability group
+        # (obs/trajectory.py; scripts/bench_trajectory.py --fold)
+        import jax
+        artifact = {
+            "metric": "fleet_cells_per_hour",
+            "value": summary_row["cells_per_hour"],
+            "device": str(jax.devices()[0]),
+            "bench_config": base_cfg.data,
+            "dtype": base_cfg.dtype,
+            "cells": summary_row["cells"], "ok": summary_row["ok"],
+            "slot_occupancy": summary_row["slot_occupancy"],
+            "scheduler_bins": summary_row["scheduler_bins"],
+            "wall_s": summary_row["wall_s"],
+        }
+        apath = os.path.join(os.path.dirname(results_path) or ".",
+                             "fleet_bench.json")
+        with open(apath, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[queue] fleet bench artifact -> {apath}")
     done = sum(r["ok"] for r in rows)
     print(f"[queue] {done}/{len(rows)} cells completed "
           f"({summary_row['cells_per_hour']} cells/hour) "
@@ -367,6 +458,11 @@ def main(argv=None) -> int:
                          "runs up to E shape-compatible cells as ONE "
                          "resident *_mt program; incompatible cells fall "
                          "back to the serial path")
+    qp.add_argument("--scheduler", action="store_true",
+                    help="resident fleet scheduler (service/scheduler.py"
+                         "): capacity-modelled bins whose completed/"
+                         "evicted slots backfill from the queue instead "
+                         "of idling; needs --tenants >= 2")
     qargs, rest = qp.parse_known_args(argv)
     base_cfg = args_parser(rest)
     if base_cfg.platform:
@@ -374,7 +470,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", base_cfg.platform)
     cells = load_cells(qargs.queue)
     rows = run_queue(base_cfg, cells, results_path=qargs.results or None,
-                     service_mode=qargs.service, tenants=qargs.tenants)
+                     service_mode=qargs.service, tenants=qargs.tenants,
+                     scheduler=qargs.scheduler)
     return 0 if all(r["ok"] for r in rows) else 1
 
 

@@ -42,6 +42,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 JOURNAL_NAME = "journal.json"
@@ -186,7 +187,16 @@ def _restore_state(path: str, params_like) -> Tuple[Dict[str, Any], Any]:
             f"checkpoint {path} stores PRNG key data of shape "
             f"{key_data.shape} but the active --rng_impl expects {key_shape};"
             f" resume under the rng_impl that wrote the checkpoint")
-    return state, jax.random.wrap_key_data(key_data)
+    # Return the key in the SAME representation a fresh engine builds
+    # (jax.random.PRNGKey). Under the default raw-key config that is a
+    # uint32 vector, and unconditionally wrapping into a typed key<fry>
+    # array here changed the program's key-argument aval — every resume,
+    # recovery rung and rlr-adapt re-entry missed the AOT bank and
+    # recompiled (the ledger-surfaced `aot/miss key<fry>` tax, ISSUE 16).
+    fresh = jax.random.PRNGKey(0)
+    if jax.dtypes.issubdtype(fresh.dtype, jax.dtypes.prng_key):
+        return state, jax.random.wrap_key_data(key_data)
+    return state, jnp.asarray(key_data)
 
 
 def newest_valid_round(ckpt_dir: str) -> Optional[int]:

@@ -64,6 +64,7 @@ line — a corrupt or version-skewed bank can cost a recompile, never a run.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import json
 import os
@@ -608,30 +609,51 @@ def plan_programs(cfg, model, norm, fed,
     if getattr(cfg, "tenants", 0) > 0:
         # tenant-pack families (ISSUE 13, fl/tenancy.py): the experiment
         # axis rides every carried array as a leading [E] dimension; the
-        # per-tenant scalar knobs are traced [E]-vector arguments
+        # per-tenant scalar knobs are traced [E]-vector arguments. In
+        # buffered mode the stacked lead is the WHOLE (params, buffer
+        # state) carry (ISSUE 16 — round_async_mt and friends)
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
             tenancy)
         rep = tenancy.canonical_rep(plain)
         tenancy.check(rep)
         E = rep.tenants
-        pE_aval = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct((E,) + a.shape, a.dtype),
-            params_aval)
+        stackE = functools.partial(
+            jax.tree_util.tree_map,
+            lambda a: jax.ShapeDtypeStruct((E,) + a.shape, a.dtype))
+        pE_aval = stackE(params_aval)
+        carryE_aval = stackE(carry_aval(rep, params_aval))
         keysE_aval = jax.ShapeDtypeStruct((E,) + key_aval.shape,
                                           key_aval.dtype)
         rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
         kavals = tenancy.knob_avals(E)
-        specs.append(ProgramSpec(
-            "round" + sfx,
-            tenancy.make_tenant_round_fn(rep, model, norm,
-                                         *data_avals).jitted,
-            (pE_aval, keysE_aval, rnd_aval, kavals) + data_avals))
-        if chain_n > 1:
+        if cohort_mode:
+            # cohort tenant pack (ISSUE 16 gap 3): shared [m] cohort
+            # stacks broadcast across tenants — one bank gather per round
+            # serves the whole pack. No chained variant: the engine
+            # dispatches cohort packs per-round (the host gather is
+            # per-round by construction).
+            shard_avals = tuple(
+                jax.ShapeDtypeStruct((m,) + a.shape[1:], a.dtype)
+                for a in data_avals)
             specs.append(ProgramSpec(
-                "chained" + sfx,
-                tenancy.make_tenant_chained_fn(rep, model, norm,
-                                               *data_avals).jitted,
-                (pE_aval, keysE_aval, ids_aval, kavals) + data_avals))
+                "round_cohort" + sfx,
+                tenancy.make_tenant_cohort_round_fn(rep, model,
+                                                    norm).jitted,
+                (carryE_aval, keysE_aval, rnd_aval, kavals)
+                + shard_avals))
+        else:
+            specs.append(ProgramSpec(
+                "round" + sfx,
+                tenancy.make_tenant_round_fn(rep, model, norm,
+                                             *data_avals).jitted,
+                (carryE_aval, keysE_aval, rnd_aval, kavals) + data_avals))
+            if chain_n > 1:
+                specs.append(ProgramSpec(
+                    "chained" + sfx,
+                    tenancy.make_tenant_chained_fn(rep, model, norm,
+                                                   *data_avals).jitted,
+                    (carryE_aval, keysE_aval, ids_aval, kavals)
+                    + data_avals))
         eval_mt = tenancy.make_tenant_eval_fn(model, norm, cfg.n_classes)
         for family, (imgs, lbls) in (
                 ("eval_val_mt", (fed.val_images, fed.val_labels)),
@@ -769,9 +791,11 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
             make_sharded_round_fn_mt)
         rep = tenancy.canonical_rep(plain)
         E = rep.tenants
-        pE_aval = jax.tree_util.tree_map(
+        # buffered: the stacked lead is the whole (params, state) carry —
+        # the sharded state shape (no per-bin accumulators), [E]-stacked
+        carryE_aval = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct((E,) + a.shape, a.dtype),
-            params_aval)
+            carry_aval(rep, params_aval, sharded=True))
         keysE_aval = jax.ShapeDtypeStruct((E,) + key_aval.shape,
                                           key_aval.dtype)
         rnd_aval = jax.ShapeDtypeStruct((), jnp.int32)
@@ -780,7 +804,7 @@ def plan_sharded_programs(cfg, model, norm, fed, mesh,
             "round_sharded" + sfx,
             make_sharded_round_fn_mt(rep, model, norm, mesh,
                                      *data_avals).jitted,
-            (pE_aval, keysE_aval, rnd_aval, kavals) + data_avals))
+            (carryE_aval, keysE_aval, rnd_aval, kavals) + data_avals))
         return specs
     if is_cohort_mode(cfg, fed):
         shard_avals = tuple(

@@ -80,7 +80,10 @@ def main(argv=None) -> int:
         verdict = "PASS" if r["pass"] else "FAIL"
         value = "—" if r["value"] is None else f"{r['value']:.4f}"
         note = f"  ({r['note']})" if r.get("note") else ""
-        print(f"[trajectory] {r['label']:>8}  {value:>10} r/s  "
+        # fleet points judge cells/hour; everything else rounds/sec
+        unit = ("c/h" if (r.get("group") or "").startswith("fleet")
+                else "r/s")
+        print(f"[trajectory] {r['label']:>8}  {value:>10} {unit}  "
               f"{verdict}{note}")
     print(f"[trajectory] {sum(r['pass'] for r in judged)}/{len(judged)} "
           f"judged point(s) pass (tolerance "

@@ -231,6 +231,13 @@ def main(argv=None):
                          "resident *_mt program; incompatible cells "
                          "fall back to the serial path with a printed "
                          "note")
+    ap.add_argument("--scheduler", action="store_true",
+                    help="with --tenants: run the fleet scheduler "
+                         "(ISSUE 16, service/scheduler.py) instead of "
+                         "FIFO packs — bin-packed admission under the "
+                         "HBM-vs-E capacity model, ledger-driven "
+                         "eviction + backfill, slot-occupancy in the "
+                         "summary row")
     ap.add_argument("--inject_bad_cell", action="store_true",
                     help="append a deliberately poisoned cell (unknown "
                          "aggregator) to prove the record-and-skip "
@@ -279,7 +286,7 @@ def main(argv=None):
           f"thr {thr}) -> {args.out}")
 
     rows = run_queue(base, cells, results_path=args.out,
-                     tenants=args.tenants)
+                     tenants=args.tenants, scheduler=args.scheduler)
     ok = [r for r in rows if r["ok"]]
     for r in rows:
         if r["ok"]:

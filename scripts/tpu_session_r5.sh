@@ -280,6 +280,52 @@ else
     say "WARN: tenancy A/B rc=$?"
 fi
 
+say "step 6d: fleet scheduler A/B (--tenants 8 --scheduler, ISSUE 16 — BENCH_NOTES r17)"
+# FIFO packs vs the resident scheduler on the SAME mixed 8-cell matrix:
+# the scheduler backfills completed/evicted slots from the queue instead
+# of idling, so its cells/hour must meet-or-beat the FIFO arm (the r17
+# acceptance); the scheduler arm drops logs/sweep_sched/fleet_bench.json,
+# folded into trajectory.json's fleet comparability group below. Not
+# run_bench-wrapped: sweep_scenarios compiles one *_mt family up front
+# and then streams rows — the heartbeat machinery is bench.py-shaped.
+# FIFO runs FIRST on purpose: it pays any residual compile bill left
+# after step 2's precompile, so the timed scheduler arm is warm — the
+# same warm-vs-warm discipline as CI's scheduler-smoke prewarm pass.
+SCHED_OK=0
+if python scripts/sweep_scenarios.py --attacks static,boost \
+        --rules avg,rlr --faults none,drop30 --rounds 60 --snap 10 \
+        --tenants 8 --log_dir logs/sweep_fifo \
+        --out logs/sweep_fifo/queue_results.jsonl >>"$LOG" 2>&1 \
+   && python scripts/sweep_scenarios.py --attacks static,boost \
+        --rules avg,rlr --faults none,drop30 --rounds 60 --snap 10 \
+        --tenants 8 --scheduler --log_dir logs/sweep_sched \
+        --out logs/sweep_sched/queue_results.jsonl >>"$LOG" 2>&1; then
+    python - <<'PY' >>"$LOG" 2>&1 && SCHED_OK=1
+import json
+def summary(path):
+    return json.loads(open(path).readlines()[-1])
+fifo = summary("logs/sweep_fifo/queue_results.jsonl")
+sched = summary("logs/sweep_sched/queue_results.jsonl")
+print(f"[r17] FIFO {fifo['cells_per_hour']} c/h vs scheduler "
+      f"{sched['cells_per_hour']} c/h "
+      f"(occupancy {sched.get('slot_occupancy')})")
+assert sched["cells_per_hour"] >= fifo["cells_per_hour"], \
+    "scheduler lost the A/B — the r17 headline finding"
+PY
+    if [ "$SCHED_OK" -eq 1 ]; then
+        cp logs/sweep_sched/fleet_bench.json BENCH_TPU_r05_fleet.json
+        python scripts/bench_trajectory.py \
+            --fold BENCH_TPU_r05_fleet.json --write >>"$LOG" 2>&1 \
+            || say "WARN: fleet trajectory fold failed"
+        say "scheduler A/B: $(cat BENCH_TPU_r05_fleet.json | tr -d '\n')"
+        SUCCESSES=$((SUCCESSES + 1))
+    else
+        say "WARN: scheduler A/B lost to FIFO or summary parse failed"
+    fi
+else
+    say "WARN: scheduler A/B sweep rc=$?"
+fi
+
 say "step 7/7: figures refresh"
 # NOT counted in SUCCESSES: plot_curves re-renders from a pre-existing
 # results.json, so it succeeds even when every measurement step failed —
@@ -299,6 +345,7 @@ for f in BENCH_TPU_r05.json BENCH_TPU_r05_faults.json \
          BENCH_TPU_r05_train_layout.json \
          BENCH_TPU_r05_train_layout_bf16.json \
          BENCH_TPU_r05_agg_mode.json BENCH_TPU_r05_tenancy.json \
+         BENCH_TPU_r05_fleet.json trajectory.json \
          sweep_faults.jsonl \
          results.json RESULTS.md performance.png \
          poison_acc.png BENCH_NOTES.md; do

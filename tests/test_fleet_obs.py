@@ -241,12 +241,14 @@ def test_console_renders_fixture_fleet(tmp_path):
 
 
 def test_trajectory_committed_series_passes():
-    """Acceptance: the committed r01–r05 series is judged PASS."""
+    """Acceptance: the committed r01–r05 + fleet series is judged PASS."""
     traj = obs_trajectory.load(os.path.join(REPO, "trajectory.json"))
     results, ok = obs_trajectory.judge(traj)
-    assert ok and len(results) == 5
-    assert {r["label"] for r in results} == {"r01", "r02", "r03",
-                                             "r04", "r05"}
+    assert ok and len(results) == 6
+    assert {r["label"] for r in results} == {"r01", "r02", "r03", "r04",
+                                             "r05", "fleet_smoke_bench"}
+    fleet = next(r for r in results if r["label"] == "fleet_smoke_bench")
+    assert fleet["group"].startswith("fleet_")
 
 
 def test_trajectory_gate_rc_0_1_2(tmp_path):

@@ -1,0 +1,351 @@
+"""Resident fleet scheduler (ISSUE 16, service/scheduler.py): capacity
+model, deterministic bin-packing, the pure slot state machine, the new
+pack paths' parity vs solo, and the live backfill/eviction loop.
+
+Parity tiers follow test_tenancy.py: tenant packs run the SAME ops with
+the same keys as the solo paths, so every experiment-derived row is
+pinned at 1e-6 (measured bit-identical on XLA:CPU at these shapes); a
+BACKFILLED cell must reproduce its solo run too — the rnd_offset knob
+replays its key streams and schedule gates solo-exactly from a non-zero
+pack round. The state machine is host logic, pinned exactly against a
+synthetic ledger-shaped event stream.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import (  # noqa: E402
+    Config)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (  # noqa: E402
+    events as obs_events)
+from defending_against_backdoors_with_robust_learning_rate_tpu.service import (  # noqa: E402
+    scheduler as fleet)
+from defending_against_backdoors_with_robust_learning_rate_tpu.service import (  # noqa: E402
+    tenancy as stenancy)
+from defending_against_backdoors_with_robust_learning_rate_tpu.service.queue import (  # noqa: E402
+    _apply_overrides, run_queue)
+
+PARITY_PREFIXES = ("Validation/", "Poison/", "Train/", "Defense/",
+                   "Faults/", "Churn/")
+
+
+def _cfg(**kw):
+    base = dict(data="synthetic", num_agents=8, bs=16, local_ep=1,
+                synth_train_size=128, synth_val_size=64, eval_bs=64,
+                rounds=2, snap=2, chain=1, num_corrupt=2, poison_frac=1.0,
+                aggr="avg", seed=3, tensorboard=False, spans=False,
+                heartbeat=False, compile_cache=False,
+                data_dir="/nonexistent_use_synthetic")
+    base.update(kw)
+    return Config(**base)
+
+
+def _rows(run_dir):
+    out = {}
+    with open(os.path.join(run_dir, "metrics.jsonl")) as f:
+        for line in f:
+            r = json.loads(line)
+            if r["tag"].startswith(PARITY_PREFIXES):
+                out[(r["tag"], r["step"])] = r["value"]
+    return out
+
+
+def _run_dir(cfg):
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+        run_name)
+    return os.path.join(cfg.log_dir, run_name(cfg))
+
+
+def _assert_rows_match(pack_rows, solo_rows, who, tol=1e-6):
+    assert set(pack_rows) == set(solo_rows), \
+        f"{who}: row tags/steps diverge: {set(pack_rows) ^ set(solo_rows)}"
+    for k in solo_rows:
+        assert abs(pack_rows[k] - solo_rows[k]) <= tol, \
+            f"{who} row {k}: {pack_rows[k]} != {solo_rows[k]}"
+
+
+# --------------------------------------------------- capacity model ---
+
+def test_capacity_model_bytes_and_width():
+    """The analytic HBM-vs-E model: per-tenant bytes scale the pack
+    width down from the user's E; buffered carry bills extra; the CPU
+    backend is capped regardless of budget."""
+    cfg = _cfg()
+    cap = fleet.CapacityModel(budget_bytes=1 << 44, backend="tpu")
+    tb = cap.tenant_bytes(cfg)
+    assert tb > 0
+    buf = cap.tenant_bytes(cfg.replace(agg_mode="buffered",
+                                       async_buffer_k=8,
+                                       straggler_rate=0.4))
+    assert buf > tb                     # the carried (sum, votes) state
+    assert cap.max_width(cfg, 16) == 16  # huge budget: the user's E wins
+    # a budget that fits exactly 3 tenants clamps the width to 3
+    tight = fleet.CapacityModel(
+        budget_bytes=int(tb * 3 / fleet.TENANT_BUDGET_FRACTION),
+        backend="tpu")
+    assert tight.max_width(cfg, 16) == 3
+    # the floor: even a starved budget packs one (serial == width 1)
+    assert fleet.CapacityModel(budget_bytes=1,
+                               backend="tpu").max_width(cfg, 16) == 1
+    # CPU: host RAM backs the "HBM" and the model is uncalibrated there
+    assert fleet.CapacityModel(budget_bytes=1 << 44,
+                               backend="cpu").max_width(cfg, 16) \
+        == fleet.CPU_MAX_WIDTH
+
+
+# ------------------------------------------------------ bin-packing ---
+
+def test_plan_fleet_deterministic_grouping(tmp_path):
+    """Same cells + same capacity model => same plan, twice: compatible
+    knob-varying cells bin together at the modelled width; a cell whose
+    program fingerprint differs becomes a singleton serial cell; a cell
+    whose config cannot even build is recorded serial (the queue will
+    row its failure) — nothing raises at planning time."""
+    base = _cfg(log_dir=str(tmp_path / "logs"))
+    cells = [
+        {"name": "thr0", "overrides": {"robustLR_threshold": 0}},
+        {"name": "thr4", "overrides": {"robustLR_threshold": 4}},
+        {"name": "seed9", "overrides": {"seed": 9}},
+        {"name": "comed", "overrides": {"aggr": "comed"}},
+        {"name": "bogus", "overrides": {"aggr": "no_such_rule"}},
+    ]
+    cap = fleet.CapacityModel(budget_bytes=1 << 44, backend="cpu")
+
+    def shape(plan):
+        return [(kind, [c["name"] for c in group], width)
+                for kind, group, width in plan]
+
+    plan = shape(fleet.plan_fleet(base, cells, 4, _apply_overrides,
+                                  capacity=cap))
+    again = shape(fleet.plan_fleet(base, cells, 4, _apply_overrides,
+                                   capacity=cap))
+    assert plan == again                        # the determinism pin
+    assert plan[0] == ("bin", ["thr0", "thr4", "seed9"], 4)
+    assert ("serial", ["comed"], 1) in plan     # fingerprint split
+    assert ("serial", ["bogus"], 1) in plan     # unbuildable -> serial
+    assert len(plan) == 3
+
+
+# ----------------------------------------------- the state machine ---
+
+def test_scheduler_synthetic_event_stream():
+    """The pure slot machine against a ledger-shaped event stream: every
+    vacate event backfills in strict queue order, an empty queue idles
+    the slot, and non-scheduler ledger records are no-ops."""
+    sched = fleet.Scheduler(2, ["A", "B"], ["C", "D", "E"])
+    assert sched.occupancy() == 1.0
+    assert sched.on_event({"event": "scheduler/slot_done", "slot": 0}) \
+        == [{"op": "backfill", "slot": 0, "item": "C"}]
+    assert sched.on_event({"event": "health/incident", "slot": 1}) \
+        == [{"op": "backfill", "slot": 1, "item": "D"}]
+    assert sched.on_event({"event": "scheduler/evict", "slot": 0}) \
+        == [{"op": "backfill", "slot": 0, "item": "E"}]
+    # queue drained: a recovering tenant's slot idles instead
+    assert sched.on_event({"event": "service/recover", "slot": 1}) \
+        == [{"op": "idle", "slot": 1}]
+    assert sched.occupancy() == 0.5
+    # a live ledger interleaves records the scheduler must ignore
+    assert sched.on_event({"event": "queue/cell_done", "slot": 0}) == []
+    assert sched.on_event({"event": "scheduler/slot_done"}) == []
+    assert sched.on_event({"event": "scheduler/slot_done",
+                           "slot": 7}) == []
+    assert [d["op"] for d in sched.decisions] == ["backfill"] * 3 \
+        + ["idle"]
+    with pytest.raises(ValueError, match="2 resident"):
+        fleet.Scheduler(1, ["A", "B"], [])
+
+
+def test_scheduler_replays_recorded_ledger(tmp_path):
+    """The state machine consumes a RECORDED ledger stream exactly like
+    the live loop's in-process events: write scheduler-shaped records
+    through EventLedger, read them back, and the replayed decisions
+    land in the recorded order."""
+    path = str(tmp_path / "events.jsonl")
+    led = obs_events.EventLedger(path, run="synthetic")
+    led.emit("scheduler/bin_start", width=2, cells=4)
+    led.emit("scheduler/slot_done", slot=1)
+    led.emit("queue/cell_done", cell="noise")
+    led.emit("health/incident", severity="warn", slot=0)
+    led.emit("scheduler/slot_done", slot=1)
+    led.close()
+    sched = fleet.Scheduler(2, ["A", "B"], ["C", "D"])
+    for rec in obs_events.read_events(path):
+        sched.on_event(rec)
+    assert [(d["op"], d["slot"], d.get("item")) for d in sched.decisions] \
+        == [("backfill", 1, "C"), ("backfill", 0, "D"),
+            ("idle", 1, None)]
+
+
+# ------------------------------------------- new pack paths: parity ---
+
+def test_buffered_pack_parity_vs_solo(tmp_path):
+    """Tenancy x buffered (the ISSUE-16 packing gap): a pack of
+    knob-varying BUFFERED cells — carried (params, state) stacked on
+    the tenant axis — matches each cell's solo buffered run row-for-row
+    at 1e-6 (K=m: every round commits)."""
+    base = _cfg(agg_mode="buffered", async_buffer_k=8,
+                straggler_rate=0.4, log_dir=str(tmp_path / "pack"))
+    cells = [base.replace(robustLR_threshold=0),
+             base.replace(robustLR_threshold=4)]
+    summaries, info = stenancy.run_pack(cells, names=["b0", "b4"])
+    assert info["tenants"] == 2
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        run)
+    for i, cell in enumerate(cells):
+        solo_cfg = cell.replace(log_dir=str(tmp_path / f"solo{i}"))
+        solo = run(solo_cfg)
+        for key in ("val_acc", "val_loss", "poison_acc", "poison_loss"):
+            assert abs(summaries[i][key] - solo[key]) <= 1e-6, \
+                f"tenant {i} {key}: pack {summaries[i][key]} " \
+                f"!= solo {solo[key]}"
+        _assert_rows_match(_rows(_run_dir(cell)), _rows(_run_dir(solo_cfg)),
+                           f"buffered tenant {i}")
+
+
+def test_buffered_sign_pack_parity_vs_solo(tmp_path):
+    """The sign rule under buffered packing: K=m commits make the vote
+    integral, so the packed tenants' metrics equal solo EXACTLY (the
+    r13 bitwise tier)."""
+    base = _cfg(aggr="sign", agg_mode="buffered",
+                async_buffer_k=8, straggler_rate=0.0,
+                log_dir=str(tmp_path / "pack"))
+    # knob-varying, NOT seed-varying: a pack's synthetic dataset comes
+    # from its first cell's seed, so seed-split cells have no solo twin
+    cells = [base.replace(robustLR_threshold=4),
+             base.replace(robustLR_threshold=6)]
+    summaries, _ = stenancy.run_pack(cells, names=["t4", "t6"])
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        run)
+    for i, cell in enumerate(cells):
+        solo_cfg = cell.replace(log_dir=str(tmp_path / f"solo{i}"))
+        solo = run(solo_cfg)
+        assert summaries[i]["val_acc"] == solo["val_acc"]
+        assert summaries[i]["poison_acc"] == solo["poison_acc"]
+        _assert_rows_match(_rows(_run_dir(cell)), _rows(_run_dir(solo_cfg)),
+                           f"sign tenant {i}", tol=0.0)
+
+
+def test_sharded_pack_parity_vs_solo(tmp_path):
+    """Tenancy x shard_map (the second ISSUE-16 packing gap): the
+    *_mt sharded families over the faked 8-device CPU mesh match each
+    cell's solo sharded run at 1e-6."""
+    base = _cfg(mesh=0, log_dir=str(tmp_path / "pack"))
+    cells = [base.replace(robustLR_threshold=0),
+             base.replace(robustLR_threshold=4)]
+    summaries, info = stenancy.run_pack(cells, names=["m0", "m4"])
+    assert info["tenants"] == 2
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        run)
+    for i, cell in enumerate(cells):
+        solo_cfg = cell.replace(log_dir=str(tmp_path / f"solo{i}"))
+        solo = run(solo_cfg)
+        for key in ("val_acc", "val_loss", "poison_acc", "poison_loss"):
+            assert abs(summaries[i][key] - solo[key]) <= 1e-6
+        _assert_rows_match(_rows(_run_dir(cell)), _rows(_run_dir(solo_cfg)),
+                           f"sharded tenant {i}")
+
+
+# ------------------------------------------------- the live loop ---
+
+def _read_queue_events(base_cfg):
+    return obs_events.read_events(
+        os.path.join(base_cfg.log_dir, "events.jsonl"))
+
+
+def _summary_row(results_path):
+    """The queue-level summary is the results file's FINAL row (streamed,
+    not returned — a mid-queue kill keeps the completed cell rows)."""
+    with open(results_path) as f:
+        last = json.loads(f.readlines()[-1])
+    assert last.get("queue_summary")
+    return last
+
+
+def test_run_bin_backfill_end_to_end(tmp_path):
+    """4 compatible cells over 2 slots: residents retire at the snap
+    boundary, backfills enter at offset=-pack_round, every cell rows ok,
+    and a BACKFILLED cell's metrics match its solo twin — the rnd_offset
+    replay contract, live."""
+    base = _cfg(events="on", log_dir=str(tmp_path / "q"),
+                checkpoint_dir=str(tmp_path / "ck"))
+    # knob-varying via the defense threshold (seed would change the
+    # shared synthetic dataset out from under the solo-twin comparison)
+    cells = [{"name": f"t{i}", "overrides": {"robustLR_threshold": 2 * i}}
+             for i in range(4)]
+    rows = run_queue(base, cells, results_path=str(tmp_path / "r.jsonl"),
+                     tenants=2, scheduler=True)
+    summary_row = _summary_row(str(tmp_path / "r.jsonl"))
+    cell_rows = {r["cell"]: r for r in rows if "cell" in r}
+    assert len(cell_rows) == 4
+    assert all(r["ok"] for r in cell_rows.values())
+    for r in cell_rows.values():        # bin rows carry both clauses
+        assert r["tenancy"]["tenants"] == 2
+        assert "admitted_round" in r["scheduler"]
+    backfilled = [r for r in cell_rows.values()
+                  if r["scheduler"]["offset"] < 0]
+    assert len(backfilled) == 2
+    assert all(r["scheduler"]["offset"] == -base.rounds
+               for r in backfilled)
+    # the fleet summary: occupancy + cells/hour, scheduler-stamped
+    assert summary_row["scheduler"]
+    assert 0.0 < summary_row["slot_occupancy"] <= 1.0
+    assert summary_row["ok"] == 4
+    events = [r["event"] for r in _read_queue_events(base)]
+    assert events.count("scheduler/admit") == 2
+    assert events.count("scheduler/backfill") == 2
+    assert events.count("scheduler/idle") == 2   # drained queue
+    assert "scheduler/bin_done" in events
+    # the replay contract: a backfilled cell == its solo twin
+    name = backfilled[0]["cell"]
+    cell = next(c for c in cells if c["name"] == name)
+    packed_cfg = _apply_overrides(base, cell["overrides"])
+    solo_cfg = packed_cfg.replace(log_dir=str(tmp_path / "solo"),
+                                  events="off")
+    from defending_against_backdoors_with_robust_learning_rate_tpu.train import (
+        run)
+    run(solo_cfg)
+    _assert_rows_match(_rows(_run_dir(packed_cfg)),
+                       _rows(_run_dir(solo_cfg)),
+                       f"backfilled cell {name}")
+
+
+def test_run_bin_eviction_backfills_from_queue(tmp_path):
+    """Per-slot health eviction: a tenant whose sign-rule server step
+    overflows (server_lr=1e38 under --health_policy abort) is evicted at
+    the round-2 boundary — health/incident + scheduler/evict on the
+    ledger, a failed row recorded — and its SLOT backfills from the
+    queue; pack-mates and backfills complete untouched."""
+    base = _cfg(aggr="sign", robustLR_threshold=4, rounds=4, snap=2,
+                events="on", log_dir=str(tmp_path / "q"),
+                checkpoint_dir=str(tmp_path / "ck"))
+    cells = [
+        {"name": "good0", "overrides": {"seed": 11}},
+        {"name": "chaos", "overrides": {"server_lr": 1e38,
+                                        "health_policy": "abort"}},
+        {"name": "good1", "overrides": {"seed": 12}},
+        {"name": "good2", "overrides": {"seed": 13}},
+    ]
+    rows = run_queue(base, cells, results_path=str(tmp_path / "r.jsonl"),
+                     tenants=2, scheduler=True)
+    by_cell = {r["cell"]: r for r in rows if "cell" in r}
+    assert len(by_cell) == 4
+    assert not by_cell["chaos"]["ok"]
+    assert "FloatingPointError" in by_cell["chaos"]["error"]
+    assert all(by_cell[n]["ok"] for n in ("good0", "good1", "good2"))
+    events = _read_queue_events(base)
+    names = [r["event"] for r in events]
+    assert "health/incident" in names
+    assert "scheduler/evict" in names
+    # the evicted slot backfilled instead of idling: the backfill lands
+    # on the SAME slot the eviction vacated, at the eviction round
+    evict = next(r for r in events if r["event"] == "scheduler/evict")
+    backfills = [r for r in events if r["event"] == "scheduler/backfill"]
+    assert any(b["slot"] == evict["slot"] and b["round"] == evict["round"]
+               for b in backfills)
+    summary_row = _summary_row(str(tmp_path / "r.jsonl"))
+    assert summary_row["ok"] == 3 and summary_row["cells"] == 4

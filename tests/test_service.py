@@ -570,6 +570,29 @@ def test_serve_crash_exact_resume_vmap(tmp_path, svc_cache):
     assert status["phase"] == "done"
 
 
+def test_resume_reenters_aot_bank(tmp_path, svc_cache):
+    """ISSUE-16 pin: a recovered service re-enters the AOT bank as a HIT.
+
+    The restored PRNG key used to come back as a typed ``key<fry>``
+    array while a fresh life holds raw ``uint32[2]`` key data, so the
+    program fingerprint split and every resume recompiled the fleet's
+    programs (utils/checkpoint._restore_state now normalises the
+    representation). The interrupted life runs without a ledger
+    (RoundEngine directly), so every aot/* record in events.jsonl
+    belongs to the resumed life."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        events as obs_events)
+    cfg = _svc_cfg(tmp_path, svc_cache, "aot", service_rounds=6)
+    # warm the bank AND leave a crash-exact interruption behind
+    _interrupt_mid_service(cfg, rounds=4, last_ckpt=2)
+    summary = serve(cfg)
+    assert summary["service"]["resumed_from"] == 2
+    events = obs_events.read_events(
+        os.path.join(cfg.log_dir, run_name(cfg), "events.jsonl"))
+    aot = [r["event"] for r in events if r["event"].startswith("aot/")]
+    assert aot and all(e == "aot/hit" for e in aot), aot
+
+
 @pytest.mark.slow  # ~30s; slow-gated (ISSUE 8 budget). Cheap twin in
 # tier-1: test_serve_crash_exact_resume_vmap drills the identical
 # recovery protocol; the sharded round body itself is parity-pinned by

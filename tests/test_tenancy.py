@@ -291,13 +291,16 @@ def test_refusals():
     assert "diagnostics" in ftenancy.ineligible_reason(
         _cfg(diagnostics=True))
     assert "pallas" in ftenancy.ineligible_reason(_cfg(use_pallas=True))
-    assert "buffered" in ftenancy.ineligible_reason(
-        _cfg(agg_mode="buffered"))
-    assert "cohort" in ftenancy.ineligible_reason(
-        _cfg(cohort_sampled="on", num_agents=8, cohort_size=4))
+    # buffered and cohort packs became ELIGIBLE in ISSUE 16 (the stacked
+    # (params, state) carry / the shared bank gather)
+    assert ftenancy.ineligible_reason(_cfg(agg_mode="buffered")) == ""
+    assert ftenancy.ineligible_reason(
+        _cfg(cohort_sampled="on", num_agents=8, cohort_size=4)) == ""
     assert "host-sampled" in stenancy.serial_reason(
         _cfg(host_sampled="on"))
-    assert "single-device" in stenancy.serial_reason(_cfg(mesh=0))
+    # the PR-13 mesh refusal is retired (ISSUE 16): the engine resolves
+    # --mesh like the solo driver and runs the sharded *_mt families
+    assert stenancy.serial_reason(_cfg(mesh=0)) == ""
     with pytest.raises(ValueError, match="tenants >= 1"):
         ftenancy.check(_cfg(tenants=0))
     with pytest.raises(ValueError, match="one tenant_pack_key"):
