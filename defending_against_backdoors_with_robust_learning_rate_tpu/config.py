@@ -243,6 +243,36 @@ class Config:
     bank_shard_clients: int = 65536  # clients per bank index-shard file
                                     # (IO layout only — bank content is
                                     # provably layout-independent)
+    bank_build_workers: int = 1     # parallel bank-build subprocesses
+                                    # (data/bank.py): whole shard files
+                                    # per worker, published bank bitwise
+                                    # identical to the serial build —
+                                    # a throughput knob like the shard
+                                    # layout, never a content input
+    # --- trace-shaped diurnal traffic (data/traffic.py, ISSUE 17) ---
+    traffic: str = "flat"           # flat | diurnal — flat keeps every
+                                    # path bit-identical; diurnal gives
+                                    # each client a seeded timezone and a
+                                    # raised-cosine daily availability
+                                    # curve feeding the participation
+                                    # mask, plus log-normal (heavy-tail)
+                                    # buffered-mode latency
+    traffic_seed: int = 0           # seeds the traffic streams —
+                                    # independent of --seed (the
+                                    # churn_seed idiom)
+    traffic_peak_frac: float = 0.8  # availability at a client's local
+                                    # daily peak
+    traffic_trough_frac: float = 0.1  # availability at the local trough
+                                    # (devices charging / offline at
+                                    # night)
+    traffic_day_rounds: int = 64    # rounds per simulated day (the
+                                    # diurnal period; timezone offsets
+                                    # spread client local time uniformly
+                                    # over it)
+    traffic_latency_sigma: float = 0.8  # log-normal sigma of the
+                                    # buffered-mode staleness draw
+                                    # (heavier tail = more very-late
+                                    # uploads), clipped to max_staleness
     # --- multi-tenant megabatched sweeps (fl/tenancy.py, ISSUE 13) ---
     tenants: int = 0                # >0: this config is a TENANT PACK of E
                                     # independent experiment replicas run
@@ -407,6 +437,13 @@ class Config:
         return self.churn_available < 1.0
 
     @property
+    def traffic_enabled(self) -> bool:
+        """Diurnal traffic is on when the model is not flat. The presence
+        mask then joins the participation-mask protocol exactly like
+        churn; "flat" keeps every path bit-for-bit."""
+        return self.traffic != "flat"
+
+    @property
     def effective_server_lr(self) -> float:
         """server_lr is forced to 1.0 unless aggr=='sign' (src/federated.py:23)."""
         return self.server_lr if self.aggr == "sign" else 1.0
@@ -566,6 +603,18 @@ FIELD_PROVENANCE = {
     "samples_per_client": "shape",  # cohort-row length via the bank's
                                     # padded max_n -> pinned by the avals
     "bank_dir": "runtime",         # storage location only
+    "bank_build_workers": "runtime",  # build throughput only — the
+                                   # published bank is bitwise identical
+                                   # at any worker count (data/bank.py)
+    "traffic": "program",          # traffic path is traced
+                                   # (data/traffic.py draws ride the
+                                   # round program, like churn)
+    "traffic_seed": "program",     # baked into the traced traffic key
+                                   # (the churn_seed idiom)
+    "traffic_peak_frac": "program",    # availability-curve shape enters
+    "traffic_trough_frac": "program",  # the traced presence draw
+    "traffic_day_rounds": "program",   # diurnal period (traced modulus)
+    "traffic_latency_sigma": "program",  # traced buffered staleness draw
     "bank_shard_clients": "runtime",  # IO shard layout; bank content is
                                       # layout-independent (test-pinned)
     "health": "program",           # the in-jit sentinel adds outputs to
@@ -870,6 +919,35 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.bank_shard_clients,
                    help="clients per bank index-shard file (IO layout "
                         "only; content is layout-independent)")
+    p.add_argument("--bank_build_workers", type=int,
+                   default=d.bank_build_workers,
+                   help="parallel bank-build subprocesses (data/bank.py; "
+                        "whole shard files per worker — the published "
+                        "bank is bitwise identical at any worker count)")
+    p.add_argument("--traffic", choices=("flat", "diurnal"),
+                   default=d.traffic,
+                   help="traffic model (data/traffic.py): flat = every "
+                        "path bit-identical; diurnal = seeded per-client "
+                        "timezones + raised-cosine daily availability "
+                        "into the participation mask, log-normal "
+                        "buffered latency")
+    p.add_argument("--traffic_seed", type=int, default=d.traffic_seed,
+                   help="seeds the traffic streams (independent of "
+                        "--seed; a program constant like --churn_seed)")
+    p.add_argument("--traffic_peak_frac", type=float,
+                   default=d.traffic_peak_frac,
+                   help="diurnal availability at a client's local daily "
+                        "peak")
+    p.add_argument("--traffic_trough_frac", type=float,
+                   default=d.traffic_trough_frac,
+                   help="diurnal availability at the local trough")
+    p.add_argument("--traffic_day_rounds", type=int,
+                   default=d.traffic_day_rounds,
+                   help="rounds per simulated day (the diurnal period)")
+    p.add_argument("--traffic_latency_sigma", type=float,
+                   default=d.traffic_latency_sigma,
+                   help="log-normal sigma of the buffered-mode staleness "
+                        "draw (clipped to [1, max_staleness])")
     p.add_argument("--tenants", type=int, default=d.tenants,
                    help="multi-tenant pack width E (fl/tenancy.py): >0 "
                         "runs E independent experiment replicas as one "

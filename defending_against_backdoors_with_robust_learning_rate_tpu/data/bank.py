@@ -28,9 +28,26 @@ storage half of that split:
   compiled round program consumes forever), fancy-indexing the base
   dataset through the memmapped index lists.
 
+Planet-scale additions (ISSUE 17):
+
+- **parallel sharded build**: ``build_bank(..., workers=N)`` splits the
+  shard range across N spawn subprocesses. Content is already a pure
+  per-client function of ``(seed, client)`` generated on a fixed global
+  block grid, so each worker writes its contiguous run of whole shard
+  files (plus sha256 sidecars) into the shared tmp dir and the parent
+  merges offsets, streams the shard files in shard order through one
+  sha256 (bitwise the serial byte stream) and publishes with the same
+  atomic rename. ``workers`` is an IO/throughput knob like
+  ``shard_clients``: same bank_key, same content_sha, same bank.
+- **streamed row gathers**: ``gather`` preads exactly the touched rows'
+  byte ranges from the shard files instead of accumulating memmap pages,
+  keeping the resident set O(cohort) at 10M+ clients (the memmap path
+  stays available as ``streamed=False`` for the bitwise-equality tests).
+
 This module is numpy-only on purpose: bank builds run in subprocesses and
 CI jobs that never initialize a jax backend, and the determinism tests
-compare content hashes across processes.
+compare content hashes across processes. (The obs import below is
+stdlib-only and no-ops unless a service ledger/exporter is installed.)
 """
 
 from __future__ import annotations
@@ -38,6 +55,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import multiprocessing
 import os
 import shutil
 from typing import Dict, List, Optional, Tuple
@@ -46,6 +64,8 @@ import numpy as np
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.data.arrays import (
     padded_max_n)
+from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+    events as obs_events)
 
 BANK_VERSION = 1
 META_NAME = "meta.json"
@@ -168,18 +188,28 @@ def _pathological_block(rng: np.random.Generator, block_size: int,
 
 def _iter_client_lists(labels: np.ndarray, *, population: int,
                        partitioner: str, spc: int, alpha: float,
-                       classes_per_client: int, seed: int, n_classes: int):
+                       classes_per_client: int, seed: int, n_classes: int,
+                       lo: int = 0, hi: Optional[int] = None):
     """Yield (first_client_id, [per-client int64 index arrays]) in client
-    order, in bounded chunks — the streaming source every build consumes."""
+    order, in bounded chunks — the streaming source every build consumes.
+
+    ``[lo, hi)`` restricts the yield to a client range WITHOUT changing
+    any client's content: blocks are always generated on the global
+    BUILD_BLOCK grid (rng keyed by the global block index, block size
+    taken from the population), then sliced to the range — the invariant
+    the parallel build rests on."""
+    hi = population if hi is None else hi
+    grid_lo = (lo // BUILD_BLOCK) * BUILD_BLOCK
     if partitioner == "label_shards":
         from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
             native)
         groups = native.distribute_data(labels, population,
                                         n_classes=n_classes)
-        for start in range(0, population, BUILD_BLOCK):
+        for start in range(grid_lo, hi, BUILD_BLOCK):
             stop = min(start + BUILD_BLOCK, population)
-            yield start, [np.asarray(list(groups.get(a, ())), dtype=np.int64)
-                          for a in range(start, stop)]
+            a0, a1 = max(start, lo), min(stop, hi)
+            yield a0, [np.asarray(list(groups.get(a, ())), dtype=np.int64)
+                       for a in range(a0, a1)]
         return
     if partitioner not in PARTITIONERS:
         raise ValueError(f"partitioner must be one of {PARTITIONERS}, "
@@ -187,7 +217,7 @@ def _iter_client_lists(labels: np.ndarray, *, population: int,
     pools = _class_pools(labels, n_classes)
     if not any(len(p) for p in pools):
         raise ValueError("cannot partition an empty dataset")
-    for start in range(0, population, BUILD_BLOCK):
+    for start in range(grid_lo, hi, BUILD_BLOCK):
         stop = min(start + BUILD_BLOCK, population)
         rng = _block_rng(seed, start // BUILD_BLOCK)
         if partitioner == "dirichlet":
@@ -195,7 +225,8 @@ def _iter_client_lists(labels: np.ndarray, *, population: int,
         else:
             block = _pathological_block(rng, stop - start, pools, spc,
                                         classes_per_client)
-        yield start, list(block)
+        a0, a1 = max(start, lo), min(stop, hi)
+        yield a0, list(block[a0 - start:a1 - start])
 
 
 @dataclasses.dataclass
@@ -210,6 +241,7 @@ class ClientBank:
     meta: Dict
     offsets: np.ndarray                       # int64 [K+1] (memmap)
     _shards: Dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
+    _files: Dict[int, object] = dataclasses.field(default_factory=dict)
 
     @property
     def population(self) -> int:
@@ -249,23 +281,62 @@ class ClientBank:
         base = int(self.offsets[s * self.shard_clients])
         return self._shard(s)[lo - base:hi - base]
 
+    def _shard_fd(self, i: int) -> int:
+        f = self._files.get(i)
+        if f is None:
+            path = os.path.join(self.dir, f"indices-{i:05d}.bin")
+            f = open(path, "rb")
+            self._files[i] = f
+        return f.fileno()
+
+    def read_client_indices(self, cid: int) -> np.ndarray:
+        """This client's sample-index list, STREAMED: one pread of
+        exactly the row's byte range into a fresh buffer. Unlike the
+        memmap view (``client_indices``) no shard pages join the resident
+        set — at 10M+ clients a long run's gathers would otherwise
+        accumulate the whole touched shard in RSS. Bitwise-equal to
+        ``client_indices`` by construction (same bytes, same dtype)."""
+        cid = int(cid)
+        lo, hi = int(self.offsets[cid]), int(self.offsets[cid + 1])
+        if lo == hi:
+            return np.empty((0,), dtype=np.int64)
+        s = cid // self.shard_clients
+        base = int(self.offsets[s * self.shard_clients])
+        buf = os.pread(self._shard_fd(s), (hi - lo) * 8, (lo - base) * 8)
+        return np.frombuffer(buf, dtype=np.int64)
+
+    def close(self) -> None:
+        """Release streamed-read file handles (memmaps close with GC;
+        the pread fds are real OS handles and deserve an explicit
+        release — long-lived drivers reopen lazily on next use)."""
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
+
     def sizes_of(self, ids) -> np.ndarray:
         ids = np.asarray(ids, dtype=np.int64)
         off = self.offsets
         return (off[ids + 1] - off[ids]).astype(np.int32)
 
     def gather(self, ids, images: np.ndarray, labels: np.ndarray,
-               max_n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+               max_n: int, streamed: bool = True
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """The cohort's padded stacks: ([m, max_n, ...] images, [m, max_n]
         labels, [m] sizes) — the exact AgentShards row layout, built for
-        the m sampled clients only."""
+        the m sampled clients only. ``streamed`` (default) preads each
+        row's byte range; ``streamed=False`` keeps the historical memmap
+        path (bitwise-identical output, larger resident set)."""
         ids = np.asarray(ids, dtype=np.int64)
+        fetch = self.read_client_indices if streamed else self.client_indices
         m = len(ids)
         out_img = np.zeros((m, max_n) + images.shape[1:], dtype=images.dtype)
         out_lbl = np.zeros((m, max_n), dtype=np.int32)
         sizes = np.zeros((m,), dtype=np.int32)
         for j, cid in enumerate(ids):
-            idx = np.asarray(self.client_indices(cid))
+            idx = np.asarray(fetch(cid))
             n = len(idx)
             sizes[j] = n
             if n:
@@ -332,32 +403,21 @@ def verify_digests(bank_dir: str, log=print) -> int:
     return checked
 
 
-def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
-               partitioner: str = "dirichlet", samples_per_client: int = 0,
-               dirichlet_alpha: float = 0.5, classes_per_client: int = 2,
-               seed: int = 0, n_classes: int = 10,
-               shard_clients: int = 65536, key: Optional[str] = None,
-               log=print) -> ClientBank:
-    """Partition once into an offset-indexed store. Streams: peak memory is
-    O(BUILD_BLOCK * samples_per_client) regardless of population. The
-    build lands in a temp dir and is renamed into place atomically, so a
-    concurrent builder (or a killed one) can never leave a half-bank that
-    opens. `key` is the precomputed bank_key of these exact inputs
-    (callers that already paid the labels hash pass it through)."""
-    labels = np.asarray(labels)
-    spc = resolve_samples_per_client(samples_per_client, len(labels),
-                                     population)
-    shard_clients = max(1, int(shard_clients))
-    if key is None:
-        key = bank_key(labels, population=population,
-                       partitioner=partitioner, samples_per_client=spc,
-                       dirichlet_alpha=dirichlet_alpha,
-                       classes_per_client=classes_per_client, seed=seed,
-                       n_classes=n_classes)
-    tmp = f"{bank_dir}.tmp.{os.getpid()}"
-    os.makedirs(tmp, exist_ok=True)
-    offsets = np.zeros(population + 1, dtype=np.int64)
-    sha = hashlib.sha256()
+def _write_range(tmp: str, labels: np.ndarray, lo: int, hi: int, *,
+                 population: int, partitioner: str, spc: int, alpha: float,
+                 classes_per_client: int, seed: int, n_classes: int,
+                 shard_clients: int, sha=None
+                 ) -> Tuple[np.ndarray, int, int]:
+    """Write the shard files covering clients ``[lo, hi)`` into ``tmp``
+    (plus sha256 sidecars). ``lo`` must be shard-aligned so every shard
+    file this range touches is written whole — the unit one build worker
+    owns. ``sha``, when given, is updated with each row's bytes in client
+    order (the serial in-process build's running content hash). Returns
+    (per-client row sizes [hi-lo], max_client_n, total_indices)."""
+    if lo % shard_clients:
+        raise ValueError(f"range start {lo} not aligned to "
+                         f"shard_clients={shard_clients}")
+    sizes = np.zeros(hi - lo, dtype=np.int64)
     max_client_n = 0
     total = 0
     shard_f = None
@@ -380,9 +440,9 @@ def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
     try:
         for start, lists in _iter_client_lists(
                 labels, population=population, partitioner=partitioner,
-                spc=spc, alpha=dirichlet_alpha,
+                spc=spc, alpha=alpha,
                 classes_per_client=classes_per_client, seed=seed,
-                n_classes=n_classes):
+                n_classes=n_classes, lo=lo, hi=hi):
             for j, idx in enumerate(lists):
                 cid = start + j
                 s = cid // shard_clients
@@ -394,17 +454,162 @@ def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
                         tmp, f"indices-{s:05d}.bin"), "wb")
                 buf = np.ascontiguousarray(idx, dtype=np.int64).tobytes()
                 shard_f.write(buf)
-                sha.update(buf)
+                if sha is not None:
+                    sha.update(buf)
                 shard_sha.update(buf)
                 n = len(idx)
                 max_client_n = max(max_client_n, n)
                 total += n
-                offsets[cid + 1] = total
+                sizes[cid - lo] = n
     finally:
         close_shard()
+    return sizes, max_client_n, total
+
+
+_WORKER_LABELS = "labels.npy"
+
+
+def _build_worker(args) -> Dict:
+    """One parallel-build subprocess: write this worker's whole-shard
+    client range. Module-level and primitive-args so the spawn context
+    can pickle it; labels come from the tmp dir (saved once by the
+    parent) rather than the pickle stream."""
+    (tmp, w, lo, hi, population, partitioner, spc, alpha,
+     classes_per_client, seed, n_classes, shard_clients) = args
+    labels = np.load(os.path.join(tmp, _WORKER_LABELS))
+    sizes, max_client_n, total = _write_range(
+        tmp, labels, lo, hi, population=population,
+        partitioner=partitioner, spc=spc, alpha=alpha,
+        classes_per_client=classes_per_client, seed=seed,
+        n_classes=n_classes, shard_clients=shard_clients)
+    # sizes ride a file, not the result pickle: at 100M clients a
+    # worker's sizes array is hundreds of MB
+    np.save(os.path.join(tmp, f"sizes-{w:05d}.npy"), sizes)
+    return {"w": w, "lo": lo, "hi": hi,
+            "max_client_n": int(max_client_n), "total": int(total),
+            "shards": (hi - lo + shard_clients - 1) // shard_clients}
+
+
+# optional Prometheus exporter for build progress (obs/export.py
+# MetricsExporter); the service driver installs its instance so a
+# multi-hour 100M build is watchable from the fleet console
+_BUILD_EXPORTER = None
+
+
+def install_build_exporter(exporter) -> None:
+    global _BUILD_EXPORTER
+    _BUILD_EXPORTER = exporter
+
+
+def _build_progress(done_clients: int, population: int) -> None:
+    if _BUILD_EXPORTER is not None:
+        _BUILD_EXPORTER.set(
+            "bank_build_clients_total", done_clients, mtype="counter",
+            help_text="clients whose bank rows have been written")
+        _BUILD_EXPORTER.set(
+            "bank_build_clients_target", population,
+            help_text="population of the bank being built")
+
+
+def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
+               partitioner: str = "dirichlet", samples_per_client: int = 0,
+               dirichlet_alpha: float = 0.5, classes_per_client: int = 2,
+               seed: int = 0, n_classes: int = 10,
+               shard_clients: int = 65536, key: Optional[str] = None,
+               workers: int = 1, log=print) -> ClientBank:
+    """Partition once into an offset-indexed store. Streams: peak memory is
+    O(BUILD_BLOCK * samples_per_client) regardless of population. The
+    build lands in a temp dir and is renamed into place atomically, so a
+    concurrent builder (or a killed one) can never leave a half-bank that
+    opens. `key` is the precomputed bank_key of these exact inputs
+    (callers that already paid the labels hash pass it through).
+
+    ``workers > 1`` fans the shard range out across spawn subprocesses
+    (whole shard files per worker, clamped to the shard count); the
+    published bank — content_sha, offsets, every shard byte — is
+    bitwise identical to the serial build's by construction, so
+    ``workers`` never joins the bank key."""
+    labels = np.asarray(labels)
+    spc = resolve_samples_per_client(samples_per_client, len(labels),
+                                     population)
+    shard_clients = max(1, int(shard_clients))
+    if key is None:
+        key = bank_key(labels, population=population,
+                       partitioner=partitioner, samples_per_client=spc,
+                       dirichlet_alpha=dirichlet_alpha,
+                       classes_per_client=classes_per_client, seed=seed,
+                       n_classes=n_classes)
+    n_shards = (population + shard_clients - 1) // shard_clients
+    workers = max(1, min(int(workers), n_shards))
+    tmp = f"{bank_dir}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    obs_events.emit("bank/build_start", population=population,
+                    partitioner=partitioner, n_shards=n_shards,
+                    workers=workers, key=key)
+    _build_progress(0, population)
+    kw = dict(population=population, partitioner=partitioner, spc=spc,
+              alpha=dirichlet_alpha,
+              classes_per_client=classes_per_client, seed=seed,
+              n_classes=n_classes, shard_clients=shard_clients)
+    if workers == 1:
+        sha = hashlib.sha256()
+        sizes, max_client_n, total = _write_range(tmp, labels, 0,
+                                                  population, sha=sha,
+                                                  **kw)
+        obs_events.emit("bank/shard_done", worker=0, shards=n_shards,
+                        clients=population, indices=int(total))
+        _build_progress(population, population)
+        content_sha = sha.hexdigest()
+    else:
+        # whole-shard contiguous ranges per worker: shard s's bytes are
+        # written by exactly one process, and the ranges tile the client
+        # axis in order — concatenating the shard files in shard order
+        # reproduces the serial content byte stream exactly
+        np.save(os.path.join(tmp, _WORKER_LABELS),
+                np.ascontiguousarray(labels, dtype=np.int64))
+        bounds = [round(n_shards * w / workers) * shard_clients
+                  for w in range(workers + 1)]
+        bounds[-1] = population
+        jobs = [(tmp, w, bounds[w], min(bounds[w + 1], population),
+                 population, partitioner, spc, dirichlet_alpha,
+                 classes_per_client, seed, n_classes, shard_clients)
+                for w in range(workers)]
+        ctx = multiprocessing.get_context("spawn")
+        done_clients = 0
+        results = []
+        with ctx.Pool(workers) as pool:
+            for res in pool.imap_unordered(_build_worker, jobs):
+                results.append(res)
+                done_clients += res["hi"] - res["lo"]
+                obs_events.emit("bank/shard_done", worker=res["w"],
+                                shards=res["shards"],
+                                clients=res["hi"] - res["lo"],
+                                indices=res["total"])
+                _build_progress(done_clients, population)
+        results.sort(key=lambda r: r["w"])
+        sizes = np.concatenate(
+            [np.load(os.path.join(tmp, f"sizes-{r['w']:05d}.npy"))
+             for r in results])
+        max_client_n = max(r["max_client_n"] for r in results)
+        total = sum(r["total"] for r in results)
+        # one global content sha: stream the finished shard files in
+        # shard order (= client order) through a single hash
+        sha = hashlib.sha256()
+        for s in range(n_shards):
+            path = os.path.join(tmp, f"indices-{s:05d}.bin")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        sha.update(chunk)
+        content_sha = sha.hexdigest()
+        os.remove(os.path.join(tmp, _WORKER_LABELS))
+        for r in results:
+            os.remove(os.path.join(tmp, f"sizes-{r['w']:05d}.npy"))
+    offsets = np.zeros(population + 1, dtype=np.int64)
+    np.cumsum(sizes, out=offsets[1:])
     np.save(os.path.join(tmp, OFFSETS_NAME), offsets)
     meta = {
-        "version": BANK_VERSION, "key": key, "content_sha": sha.hexdigest(),
+        "version": BANK_VERSION, "key": key, "content_sha": content_sha,
         "population": population, "partitioner": partitioner,
         "samples_per_client": spc, "dirichlet_alpha": dirichlet_alpha,
         "classes_per_client": classes_per_client, "seed": seed,
@@ -430,9 +635,14 @@ def build_bank(bank_dir: str, labels: np.ndarray, *, population: int,
             if not os.path.isdir(bank_dir):
                 raise
             shutil.rmtree(tmp)
+    obs_events.emit("bank/published", population=population,
+                    n_shards=meta["n_shards"], workers=workers,
+                    content_sha=content_sha, dir=bank_dir)
     log(f"[bank] {partitioner} partition of {population:,} clients "
         f"({total:,} index rows, max shard {max_client_n}, "
-        f"{meta['n_shards']} shard file(s)) -> {bank_dir}")
+        f"{meta['n_shards']} shard file(s)"
+        + (f", {workers} build workers" if workers > 1 else "")
+        + f") -> {bank_dir}")
     return ClientBank.open(bank_dir)
 
 
@@ -441,7 +651,7 @@ def get_or_build(bank_dir: str, labels: np.ndarray, *, population: int,
                  dirichlet_alpha: float, classes_per_client: int,
                  seed: int, n_classes: int, shard_clients: int,
                  key: Optional[str] = None, verify: bool = False,
-                 log=print) -> Tuple[ClientBank, bool]:
+                 workers: int = 1, log=print) -> Tuple[ClientBank, bool]:
     """Open `bank_dir` when its key matches this config, else (re)build.
     Returns (bank, built). `key` = precomputed bank_key of these inputs
     (the labels sha256 is the expensive part — callers that already
@@ -485,5 +695,5 @@ def get_or_build(bank_dir: str, labels: np.ndarray, *, population: int,
                       dirichlet_alpha=dirichlet_alpha,
                       classes_per_client=classes_per_client, seed=seed,
                       n_classes=n_classes, shard_clients=shard_clients,
-                      key=key, log=log)
+                      key=key, workers=workers, log=log)
     return bank, True

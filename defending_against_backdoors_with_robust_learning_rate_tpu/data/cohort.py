@@ -20,16 +20,27 @@ properties:
   serves every round and every resume.
 
 Sampling model (O(cohort), never O(population)): draw ``C`` candidate ids
-with replacement (C = an oversample of m, scaled by churn availability),
-mark each candidate *eligible* iff it is the first occurrence of its id
-(dedup) AND its client is churn-present this round
-(service/churn.active_slots — cohorts are sampled from the present set,
+with replacement (C = an oversample of m, scaled by churn + traffic
+availability), mark each candidate *eligible* iff it is the first
+occurrence of its id (dedup) AND its client is churn-present AND
+traffic-present this round (service/churn.active_slots,
+data/traffic.present_slots — cohorts are sampled from the present set,
 retiring the host-sampled + churn refusal), then take the first m
 eligible candidates. If fewer than m are eligible (tiny populations,
 deep churn), the cohort is padded with ineligible candidates whose
 ``active=False`` flag routes them into the participation mask — they are
 excluded from aggregation exactly like a dropped client, so correctness
 degrades gracefully instead of ever resampling with a different shape.
+
+Deep churn / diurnal troughs push the needed oversample past one
+candidate matrix: the draw then becomes a **chunked rejection resample**
+(ISSUE 17) — a ``lax.scan`` over MAX_CANDIDATES-sized chunks, each chunk
+deduped within itself AND against the already-selected ids, scattering
+its fresh eligible candidates into the next open cohort slots. The
+single-chunk path keeps the exact historical op sequence, so every
+config that fit under the old cap draws bit-identical cohorts; the loud
+refusal now fires only when even MAX_DRAW_CHUNKS chunks could not cover
+the oversample.
 """
 
 from __future__ import annotations
@@ -41,12 +52,21 @@ import jax.numpy as jnp
 import numpy as np
 
 # fold_in tag separating the cohort stream from every other PRNGKey stream
-# (churn uses 0xC4A21, faults 0x5FA17)
+# (churn uses 0xC4A21, faults 0x5FA17, traffic 0x7AF1C)
 COHORT_KEY_TAG = 0xC0407
 
 # candidate-matrix bound: the dedup is an O(C^2) comparison, so cap C
-# (4096^2 bools = 16 MiB of trace-local work — fine; beyond it, raise)
+# (4096^2 bools = 16 MiB of trace-local work — fine; beyond it, chunk)
 MAX_CANDIDATES = 4096
+
+# chunked-draw bound: at most this many MAX_CANDIDATES chunks per round
+# (64 * 4096 = 262144 candidates — availability floors around 0.5% at
+# paper-scale cohorts); past it the refusal stays loud
+MAX_DRAW_CHUNKS = 64
+
+# availability floor entering the oversample: below this the chunked
+# draw would need more than MAX_DRAW_CHUNKS chunks anyway
+MIN_AVAILABILITY = 0.005
 
 
 def cohort_key(cfg):
@@ -55,35 +75,79 @@ def cohort_key(cfg):
                               COHORT_KEY_TAG)
 
 
+def availability(cfg) -> float:
+    """Expected fraction of the population reachable on a given round:
+    churn availability x the traffic model's mean availability (the
+    diurnal curve averages to its midpoint) — the oversample scale."""
+    avail = float(cfg.churn_available) if cfg.churn_enabled else 1.0
+    if cfg.traffic_enabled:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            traffic as traffic_mod)
+        avail *= traffic_mod.mean_available(cfg)
+    return avail
+
+
 def oversample_count(cfg) -> int:
-    """C: how many candidates one round draws. 2x the cohort, scaled up by
-    churn availability (absent candidates are ineligible), capped at the
-    population-ish scale only through MAX_CANDIDATES."""
+    """C: how many candidates one round draws in total. 2x the cohort,
+    scaled up by churn x traffic availability (absent candidates are
+    ineligible). Counts past MAX_CANDIDATES are served by the chunked
+    rejection draw; the loud refusal fires only past
+    MAX_CANDIDATES * MAX_DRAW_CHUNKS (availability below
+    ~MIN_AVAILABILITY at a big cohort — the population genuinely cannot
+    fill it round after round)."""
     m = cfg.agents_per_round
-    avail = cfg.churn_available if cfg.churn_enabled else 1.0
-    c = int(np.ceil(2.0 * m / max(float(avail), 0.05)))
+    c = int(np.ceil(2.0 * m / max(availability(cfg), MIN_AVAILABILITY)))
     c = max(c, m + 8)
-    if c > MAX_CANDIDATES:
+    if c > MAX_CANDIDATES * MAX_DRAW_CHUNKS:
         raise ValueError(
             f"cohort oversample {c} exceeds MAX_CANDIDATES="
-            f"{MAX_CANDIDATES} (cohort {m}, churn_available "
-            f"{cfg.churn_available}); shrink the cohort or raise "
-            f"availability")
+            f"{MAX_CANDIDATES} x MAX_DRAW_CHUNKS={MAX_DRAW_CHUNKS} "
+            f"(cohort {m}, availability {availability(cfg):.4f}); "
+            f"shrink the cohort or raise availability")
     return c
 
 
+def draw_plan(cfg):
+    """(per-chunk candidate count, n_chunks) for this config's draw.
+    One chunk keeps the historical single-matrix op sequence (and its
+    bit-exact cohorts); more chunks select the chunked rejection
+    resample."""
+    c = oversample_count(cfg)
+    if c <= MAX_CANDIDATES:
+        return c, 1
+    return MAX_CANDIDATES, -(-c // MAX_CANDIDATES)
+
+
 def cohort_feasible(cfg) -> bool:
-    """Can this config's implied cohort be sampled at all? False when the
-    oversample would blow MAX_CANDIDATES (e.g. cohort_size unset at a big
-    population, so m = floor(K * agent_frac) is population-sized).
-    `is_cohort_mode`'s auto path consults this so such configs stay on
-    their historical dense path instead of crashing; an explicit
-    --cohort_sampled on still raises the loud ValueError."""
+    """Can this config's implied cohort be sampled at all? False when
+    even the chunked draw could not cover the oversample (availability
+    below the floor at a big cohort). `is_cohort_mode`'s auto path
+    consults this so such configs stay on their historical dense path
+    instead of crashing; an explicit --cohort_sampled on still raises
+    the loud ValueError."""
     try:
         oversample_count(cfg)
     except ValueError:
         return False
     return True
+
+
+def _present(cfg, cand, rnd):
+    """[C] bool: candidate is reachable this round — churn presence AND
+    traffic (diurnal) presence, both pure functions of (client, round)."""
+    ok = None
+    if cfg.churn_enabled:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
+            churn as churn_mod)
+        with jax.named_scope("cohort_churn_presence"):
+            ok = churn_mod.active_slots(cfg, cand, rnd)
+    if cfg.traffic_enabled:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            traffic as traffic_mod)
+        with jax.named_scope("cohort_traffic_presence"):
+            present = traffic_mod.present_slots(cfg, cand, rnd)
+        ok = present if ok is None else ok & present
+    return ok
 
 
 def sample_cohort(cfg, rnd):
@@ -92,27 +156,62 @@ def sample_cohort(cfg, rnd):
     ``rnd`` may be a traced int32 scalar (inside the round program) or a
     Python int (the host mirror) — same jax ops, bit-identical answer.
     ``active`` is False only for shortfall padding (duplicate or
-    churn-absent candidates used to fill the fixed shape); callers AND it
+    absent candidates used to fill the fixed shape); callers AND it
     into the participation mask."""
     K, m = cfg.num_agents, cfg.agents_per_round
-    C = oversample_count(cfg)
+    C, n_chunks = draw_plan(cfg)
     k = jax.random.fold_in(cohort_key(cfg), rnd)
-    cand = jax.random.randint(k, (C,), 0, K, dtype=jnp.int32)
-    # first-occurrence dedup: argmax over the boolean equality row returns
-    # the FIRST matching position
-    eq = cand[:, None] == cand[None, :]
-    first = jnp.argmax(eq, axis=1) == jnp.arange(C)
-    eligible = first
-    if cfg.churn_enabled:
-        from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
-            churn as churn_mod)
-        with jax.named_scope("cohort_churn_presence"):
-            eligible = eligible & churn_mod.active_slots(cfg, cand, rnd)
-    # stable partition: eligible candidates first, original draw order
-    # preserved on both sides (unique composite keys make any sort stable)
-    key_order = jnp.where(eligible, 0, 1) * C + jnp.arange(C)
-    order = jnp.argsort(key_order)[:m]
-    return cand[order], eligible[order]
+    if n_chunks == 1:
+        cand = jax.random.randint(k, (C,), 0, K, dtype=jnp.int32)
+        # first-occurrence dedup: argmax over the boolean equality row
+        # returns the FIRST matching position
+        eq = cand[:, None] == cand[None, :]
+        first = jnp.argmax(eq, axis=1) == jnp.arange(C)
+        eligible = first
+        present = _present(cfg, cand, rnd)
+        if present is not None:
+            eligible = eligible & present
+        # stable partition: eligible candidates first, original draw
+        # order preserved on both sides (unique composite keys make any
+        # sort stable)
+        key_order = jnp.where(eligible, 0, 1) * C + jnp.arange(C)
+        order = jnp.argsort(key_order)[:m]
+        return cand[order], eligible[order]
+
+    # chunked rejection resample: scan MAX_CANDIDATES-sized chunks, each
+    # deduped within itself and against the already-selected ids, its
+    # eligible candidates scattered into the next open cohort slots.
+    # Static chunk count => one compiled program per config, O(C * m)
+    # cross-chunk compare per chunk — never O(population).
+    def body(carry, chunk):
+        sel, sel_ok, cnt = carry
+        kc = jax.random.fold_in(k, chunk)
+        cand = jax.random.randint(kc, (C,), 0, K, dtype=jnp.int32)
+        eq = cand[:, None] == cand[None, :]
+        first = jnp.argmax(eq, axis=1) == jnp.arange(C)
+        dup_prev = jnp.any((cand[:, None] == sel[None, :])
+                           & sel_ok[None, :], axis=1)
+        eligible = first & ~dup_prev
+        present = _present(cfg, cand, rnd)
+        if present is not None:
+            eligible = eligible & present
+        # scatter the chunk's eligible candidates, draw order preserved,
+        # into slots cnt..; overflow past m (and every ineligible slot)
+        # routes to index m, which mode="drop" discards
+        rank = jnp.cumsum(eligible) - 1
+        dest = jnp.where(eligible, cnt + rank, m)
+        sel = sel.at[dest].set(cand, mode="drop")
+        sel_ok = sel_ok.at[dest].set(True, mode="drop")
+        cnt = jnp.minimum(cnt + eligible.sum(), m)
+        return (sel, sel_ok, cnt), None
+
+    init = (jnp.zeros((m,), dtype=jnp.int32),
+            jnp.zeros((m,), dtype=bool), jnp.int32(0))
+    (sel, sel_ok, _), _ = jax.lax.scan(body, init,
+                                       jnp.arange(n_chunks))
+    # shortfall slots keep id 0 with active=False — participation-masked
+    # out of aggregation exactly like the single-chunk padding
+    return sel, sel_ok
 
 
 @functools.lru_cache(maxsize=16)

@@ -167,7 +167,8 @@ def get_cohort_data(cfg) -> CohortData:
         dirichlet_alpha=cfg.dirichlet_alpha,
         classes_per_client=cfg.classes_per_client, seed=cfg.seed,
         n_classes=cfg.n_classes, shard_clients=cfg.bank_shard_clients,
-        key=key, verify=cfg.bank_verify)
+        key=key, verify=cfg.bank_verify,
+        workers=cfg.bank_build_workers)
     if not built:
         print(f"[bank] opened existing {cfg.partitioner} bank "
               f"({bank.population:,} clients) at {bank.dir}")

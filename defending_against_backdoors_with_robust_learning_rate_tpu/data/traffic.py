@@ -1,0 +1,117 @@
+"""Trace-shaped diurnal traffic: per-client timezone offsets, a daily
+availability curve, and heavy-tailed upload latency — every draw a pure
+function of (client id, round).
+
+Flat Bernoulli availability (service/churn.py models multi-round
+lifecycles, faults/model.py within-round dropouts) misses the dominant
+structure of real FL traffic: device availability follows the sun.
+Clients charge-and-idle at night local time, so the reachable population
+swings by multiples over a day, and per-client upload latency is
+heavy-tailed rather than uniform (FedJAX 2108.02117 and FL_PyTorch
+2202.03099 both name availability realism the open simulator problem).
+This module adds that shape with the exact discipline churn established:
+
+- **pure function of (client, round)**: each client gets a seeded
+  timezone offset in ``[0, traffic_day_rounds)``; its local time of day
+  is ``(rnd + offset) mod traffic_day_rounds``. Availability follows a
+  raised-cosine diurnal curve between ``traffic_trough_frac`` (local
+  night) and ``traffic_peak_frac`` (local peak); presence at round
+  ``rnd`` is a per-(client, round) uniform draw against that curve.
+  O(1) per query, NO sequential state — crash recovery reconstructs the
+  identical traffic history from the config alone.
+- **replicated, collective-free**: draws depend only on program
+  constants (``traffic_seed``) and traced per-slot values, so every
+  device computes the identical mask — ZERO new collectives; the [m]
+  presence bools AND into the participation mask exactly like churn.
+- **heavy-tailed latency**: buffered/async mode draws each straggler's
+  staleness from a log-normal (``traffic_latency_sigma``) clipped to
+  ``[1, max_staleness]`` instead of the uniform randint — the same key
+  derivation, so the fl/buffered.py host mirror stays bit-identical.
+
+The stream derives from ``cfg.traffic_seed`` (its own `program` config
+field), NOT from ``cfg.seed`` — the traffic pattern can be re-drawn
+without perturbing any training stream, and distinct fold_in tags keep
+it disjoint from churn (0xC4A21), cohort (0xC0407), faults (0x5FA17)
+and the async stream (0xA51C).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fold_in tag separating the traffic stream from every other
+# PRNGKey-derived stream
+TRAFFIC_KEY_TAG = 0x7AF1C
+
+TRAFFIC_MODES = ("flat", "diurnal")
+
+
+def traffic_key(cfg):
+    """Base key of the traffic streams (a traced program constant)."""
+    return jax.random.fold_in(jax.random.PRNGKey(cfg.traffic_seed),
+                              TRAFFIC_KEY_TAG)
+
+
+def mean_available(cfg) -> float:
+    """Day-averaged availability: the raised cosine integrates to the
+    midpoint of trough and peak — the cohort oversample's scale factor."""
+    if not cfg.traffic_enabled:
+        return 1.0
+    return 0.5 * (float(cfg.traffic_peak_frac)
+                  + float(cfg.traffic_trough_frac))
+
+
+def availability_curve(cfg, local_t):
+    """[...] float32 availability at local time-of-day ``local_t`` (in
+    rounds): trough + (peak - trough) * (1 + cos(2*pi*t/day)) / 2 —
+    peak at local midnight-of-the-curve t=0, trough half a day later.
+    Shared by the presence draw and the host-side census."""
+    day = max(1, int(cfg.traffic_day_rounds))
+    lo = jnp.float32(cfg.traffic_trough_frac)
+    hi = jnp.float32(cfg.traffic_peak_frac)
+    phase = 2.0 * jnp.pi * local_t.astype(jnp.float32) / day
+    return lo + (hi - lo) * 0.5 * (1.0 + jnp.cos(phase))
+
+
+def present_slots(cfg, client_ids, rnd):
+    """[m] bool — is each client traffic-reachable at round ``rnd``?
+
+    ``client_ids`` is any int array of client ids; ``rnd`` may be a
+    traced int32 scalar (inside the round program) or a Python int (the
+    host mirror — same jax ops, bit-identical answer)."""
+    day = max(1, int(cfg.traffic_day_rounds))
+    base = traffic_key(cfg)
+
+    def one(cid):
+        k_tz, k_draw = jax.random.split(jax.random.fold_in(base, cid))
+        # the seeded timezone offset spreads local midnights across the
+        # population: at any wall-clock round some of the world is at
+        # peak and some in its trough
+        off = jax.random.randint(k_tz, (), 0, day)
+        local_t = (rnd + off) % day
+        p = availability_curve(cfg, local_t)
+        return jax.random.uniform(jax.random.fold_in(k_draw, rnd)) < p
+
+    return jax.vmap(one)(jnp.asarray(client_ids, jnp.int32))
+
+
+def latency_quantile(cfg, u, max_staleness: int):
+    """Map uniform draws ``u`` in [0,1) to heavy-tailed integer staleness
+    in [1, max_staleness]: the log-normal quantile exp(sigma * PPF(u)),
+    ceil'd and clipped. Shared by the traced latency draw and its host
+    mirror (same ops => bit-identical)."""
+    sigma = jnp.float32(cfg.traffic_latency_sigma)
+    # inverse-CDF of the standard normal via erfinv (jax-native, no scipy)
+    z = jnp.sqrt(jnp.float32(2.0)) * jax.scipy.special.erfinv(
+        2.0 * u.astype(jnp.float32) - 1.0)
+    t = jnp.ceil(jnp.exp(sigma * z))
+    return jnp.clip(t, 1, max_staleness).astype(jnp.int32)
+
+
+def census(cfg, rnd: int) -> int:
+    """Host-side census: how many of the K clients are traffic-present
+    at round ``rnd``. Observability only — never on the hot path."""
+    return int(np.asarray(jnp.sum(present_slots(
+        cfg, jnp.arange(cfg.num_agents), int(rnd)))))

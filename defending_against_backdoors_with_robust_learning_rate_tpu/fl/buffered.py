@@ -176,15 +176,26 @@ def latency(cfg, k_noise, straggler):
     """[m] int32 arrival latency in ticks, or None when no client can be
     late. Rides the straggler machinery: ``straggler`` is the fault
     draw's Bernoulli straggler flags ([m] bool, faults/model.py); a slow
-    client's latency is uniform in [1, async_max_staleness]. Keyed off
-    the round's fault stream with its own fold_in tag, so existing fault
-    draws are untouched and the draw replicates across a mesh."""
+    client's latency is uniform in [1, async_max_staleness] — or, under
+    --traffic diurnal, heavy-tailed log-normal (data/traffic.py
+    latency_quantile, same clip range) — uploads mostly land next tick
+    with a genuine tail of very-late arrivals. Keyed off the round's
+    fault stream with its own fold_in tag, so existing fault draws are
+    untouched and the draw replicates across a mesh; the flat path keeps
+    the historical randint bit-for-bit."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
         model as fmodel)
     if not has_pending(cfg) or straggler is None:
         return None
     k = jax.random.fold_in(fmodel.fault_key(k_noise), ASYNC_KEY_TAG)
-    t = jax.random.randint(k, straggler.shape, 1, max_staleness(cfg) + 1)
+    if cfg.traffic_enabled:
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            traffic as traffic_mod)
+        u = jax.random.uniform(k, straggler.shape)
+        t = traffic_mod.latency_quantile(cfg, u, max_staleness(cfg))
+    else:
+        t = jax.random.randint(k, straggler.shape, 1,
+                               max_staleness(cfg) + 1)
     return jnp.where(straggler, t, 0)
 
 

@@ -95,14 +95,16 @@ def host_takes_flags(cfg) -> bool:
 def step_takes_round(cfg) -> bool:
     """Whether the round step takes the round index as a traced int32
     lead argument: the churn lifecycle is a function of time
-    (service/churn.py), and so is a scheduled in-jit attack
-    (attack/schedule.py). Single source for the step builders here and
-    in parallel/rounds.py, the driver's dispatch (train.py) and the AOT
-    aval planner — their signatures must agree. (Cohort steps always
-    take the round index regardless — their sampling consumes it.)"""
+    (service/churn.py), so is diurnal traffic (data/traffic.py), and so
+    is a scheduled in-jit attack (attack/schedule.py). Single source for
+    the step builders here and in parallel/rounds.py, the driver's
+    dispatch (train.py) and the AOT aval planner — their signatures must
+    agree. (Cohort steps always take the round index regardless — their
+    sampling consumes it.)"""
     from defending_against_backdoors_with_robust_learning_rate_tpu.attack import (
         registry as attack_registry)
-    return cfg.churn_enabled or attack_registry.needs_round(cfg)
+    return (cfg.churn_enabled or cfg.traffic_enabled
+            or attack_registry.needs_round(cfg))
 
 
 def vmap_agents(local_train, params, imgs, lbls, sizes, keys,
@@ -474,6 +476,16 @@ def _make_sample_step(cfg, model, normalize):
                 churn as churn_mod)
             with jax.named_scope("churn_mask"):
                 churn_active = churn_mod.active_slots(cfg, sampled, rnd)
+        if cfg.traffic_enabled:
+            # diurnal traffic presence (data/traffic.py) composes into
+            # the same participation mask as churn — an unreachable
+            # client is excluded arithmetically, zero extra collectives
+            from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+                traffic as traffic_mod)
+            with jax.named_scope("traffic_mask"):
+                t_present = traffic_mod.present_slots(cfg, sampled, rnd)
+            churn_active = (t_present if churn_active is None
+                            else churn_active & t_present)
         if health_sentinel.has_quarantine(cfg):
             # quarantined clients (health/monitor.py QUARANTINE rung)
             # leave the electorate through the participation mask — a
@@ -582,6 +594,12 @@ def make_host_step(cfg, model, normalize, take_flags=None):
         raise ValueError(
             "client churn (--churn_available < 1) is not supported in "
             "host-sampled mode; run device-resident (--host_sampled off)")
+    if cfg.traffic_enabled:
+        # same contract as churn: the diurnal presence draw needs the
+        # sampled client ids, which the host-sampled program never sees
+        raise ValueError(
+            "diurnal traffic (--traffic diurnal) is not supported in "
+            "host-sampled mode; run device-resident or cohort-sampled")
     if buffered.is_buffered(cfg):
         # same contract as churn: the buffered arrival draw and carried
         # buffer have no host-sampled channel (fl/buffered.check names

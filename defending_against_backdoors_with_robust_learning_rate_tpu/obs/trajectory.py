@@ -37,7 +37,8 @@ VERSION = 1
 # the same series in their own comparability group — a fleet cells/hour
 # number is never compared against a solo rounds/sec flagship
 METRICS = {"fl_rounds_per_sec": "rounds_per_sec",
-           "fleet_cells_per_hour": "cells_per_hour"}
+           "fleet_cells_per_hour": "cells_per_hour",
+           "bank_build_clients_per_sec": "clients_per_sec"}
 
 
 class MalformedArtifact(ValueError):
@@ -87,6 +88,12 @@ def parse_artifact(path: str) -> Dict[str, Any]:
     group = _group_key(parsed)
     if metric == "fleet_cells_per_hour":
         group = f"fleet_{group}"
+    elif metric == "bank_build_clients_per_sec":
+        # build throughput joins its own group keyed by the pinned cell
+        # (population + worker count) — a 4-worker 1M number must never
+        # be judged against serial or a different population
+        group = (f"bank_build_{group}|pop{parsed.get('population', 0)}"
+                 f"|w{parsed.get('workers', 1)}")
     point = {
         "label": label, "source": source, "ok": True,
         "metric": metric,
@@ -97,7 +104,8 @@ def parse_artifact(path: str) -> Dict[str, Any]:
     for key in ("mfu", "tflops_per_sec", "tflop_per_round", "compile_s",
                 "chain", "vs_baseline", "dtype", "bench_config",
                 "reduced_shapes", "backend_note", "slot_occupancy",
-                "cells", "scheduler_bins", "wall_s"):
+                "cells", "scheduler_bins", "wall_s", "population",
+                "workers", "shard_clients"):
         if key in parsed:
             point[key] = parsed[key]
     return point

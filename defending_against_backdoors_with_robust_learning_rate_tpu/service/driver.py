@@ -184,6 +184,12 @@ def serve(cfg: Config, writer: Optional[MetricsWriter] = None,
                   "jax_version": jax.__version__},
             base_labels={"run": run})
         created_export = True
+        # bank-build progress counters (ISSUE 17) ride the same scrape
+        # endpoint; the bank module keeps its numpy-only import surface
+        # by taking the exporter by reference rather than importing it
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            bank as bank_mod)
+        bank_mod.install_build_exporter(exporter)
         if exporter.port:
             print(f"[export] Prometheus /metrics on port {exporter.port}"
                   + (f" + textfile {cfg.metrics_textfile}"

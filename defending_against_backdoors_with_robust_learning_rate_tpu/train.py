@@ -290,32 +290,34 @@ class RoundEngine:
         # test monkeypatching
         host_mode = (not cohort_mode) and compile_cache.is_host_mode(
             cfg, fed, threshold=DEVICE_RESIDENT_BYTES)
-        if host_mode and cfg.churn_enabled:
-            # churn-aware cohorting (ROADMAP carry-over from PR 6): a
-            # host-sampled run under churn routes through the cohort
-            # program — cohorts sampled in-program from the churn-present
-            # set over the dense host stacks — instead of the old loud
+        if host_mode and (cfg.churn_enabled or cfg.traffic_enabled):
+            # churn/traffic-aware cohorting (ROADMAP carry-over from PR
+            # 6; diurnal traffic joins in ISSUE 17): a host-sampled run
+            # under churn or diurnal traffic routes through the cohort
+            # program — cohorts sampled in-program from the present set
+            # over the dense host stacks — instead of the old loud
             # refusal. The decision defers to is_cohort_mode (the same
             # single source the planner and precompile consult), which
             # honors an explicit --cohort_sampled off AND requires the
             # implied cohort to be samplable; either way the refusal
             # stays loud rather than crashing mid-construction.
+            what = "churn" if cfg.churn_enabled else "traffic"
             if compile_cache.is_cohort_mode(
                     cfg, fed, threshold=DEVICE_RESIDENT_BYTES):
                 cohort_mode, host_mode = True, False
-                print("[cohort] host-sampled + churn: cohorts are "
-                      "sampled from the churn-present set (the refusal "
+                print(f"[cohort] host-sampled + {what}: cohorts are "
+                      f"sampled from the {what}-present set (the refusal "
                       "path is retired)")
             else:
                 raise ValueError(
-                    "host-sampled + churn needs the cohort program "
-                    "(cohorts sampled from the churn-present set), but "
+                    f"host-sampled + {what} needs the cohort program "
+                    f"(cohorts sampled from the {what}-present set), but "
                     "this config cannot take it: --cohort_sampled is "
                     "'off', or the implied cohort of "
                     f"{cfg.agents_per_round} clients is not samplable "
                     "(data/cohort.py MAX_CANDIDATES) — set "
-                    "--cohort_size, raise --churn_available, or disable "
-                    "churn")
+                    "--cohort_size, raise availability, or disable "
+                    f"{what}")
         n_mesh = 1
         if cfg.mesh != 1 and not host_mode and not cohort_mode:
             from defending_against_backdoors_with_robust_learning_rate_tpu.parallel.mesh import (
@@ -718,6 +720,17 @@ class RoundEngine:
                   f"{cfg.churn_period} rounds, churn_seed {cfg.churn_seed} "
                   f"(service/churn.py; away clients ride the "
                   f"participation mask)")
+        if cfg.traffic_enabled:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+                traffic as traffic_mod)
+            print(f"[traffic] diurnal availability: peak "
+                  f"{cfg.traffic_peak_frac} / trough "
+                  f"{cfg.traffic_trough_frac} over "
+                  f"{cfg.traffic_day_rounds}-round days (mean "
+                  f"{traffic_mod.mean_available(cfg):.2f}), latency sigma "
+                  f"{cfg.traffic_latency_sigma}, traffic_seed "
+                  f"{cfg.traffic_seed} (data/traffic.py; present clients "
+                  f"ride the participation mask)")
 
         if jax.process_count() > 1 and n_mesh <= 1:
             # no global-mesh SPMD path was taken: every process would run

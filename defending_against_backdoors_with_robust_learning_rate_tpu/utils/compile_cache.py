@@ -119,10 +119,12 @@ EXCLUDED_FIELDS = frozenset({
     "bank_verify",
     # population axis (ISSUE 7): `cohort_sampled` selects the cohort
     # program families (names key the fingerprint, like host_sampled);
-    # bank storage location / IO shard layout never shape a program
-    # (cohort_seed/cohort_size and the partitioner fields by contrast DO
-    # shape programs or data and are fingerprinted)
+    # bank storage location / IO shard layout / build parallelism never
+    # shape a program (cohort_seed/cohort_size and the partitioner
+    # fields by contrast DO shape programs or data and are
+    # fingerprinted; the traffic_* fields are traced and stay in)
     "cohort_sampled", "bank_dir", "bank_shard_clients",
+    "bank_build_workers",
     # online RLR-threshold adaptation (attack/adapt.py): a host-side
     # service policy — it ACTS by rebuilding programs with a different
     # robustLR_threshold (which is fingerprinted), never by changing a
@@ -537,20 +539,25 @@ def is_cohort_mode(cfg, fed=None, threshold: Optional[int] = None) -> bool:
     if cfg.cohort_sampled == "off":
         return False
     if cfg.num_agents >= COHORT_AUTO_MIN_POPULATION:
-        # auto additionally requires the implied cohort to be samplable:
-        # with --cohort_size unset, m = floor(K * agent_frac) can be
-        # population-sized, and auto-routing such a config into the
-        # cohort sampler would CRASH a previously-working dense run
-        # (oversample > MAX_CANDIDATES). Infeasible => stay dense, with
-        # a hint printed by the engine; an explicit `on` stays loud.
+        # auto additionally requires the implied cohort to be samplable
+        # AND genuinely smaller than the population: with --cohort_size
+        # unset, m = floor(K * agent_frac) can be population-sized — the
+        # chunked draw could now sample it, but a population-sized
+        # "cohort" is just the dense layout with extra steps, and
+        # auto-rerouting it would silently change previously-working
+        # dense runs. Such configs stay dense, with a hint printed by
+        # the engine; an explicit `on` still wins above.
         from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
             cohort as cohort_mod)
-        return cohort_mod.cohort_feasible(cfg)
-    if fed is not None and cfg.churn_enabled \
+        return (cfg.agents_per_round < cfg.num_agents
+                and cohort_mod.cohort_feasible(cfg))
+    if fed is not None and (cfg.churn_enabled or cfg.traffic_enabled) \
             and is_host_mode(cfg, fed, threshold):
-        # churn-aware cohorting for host-sampled runs — only when the
-        # cohort is actually samplable; the driver refuses loudly
-        # otherwise (the PR-6 behavior)
+        # churn/traffic-aware cohorting for host-sampled runs — both
+        # presence draws need the sampled client ids, which the
+        # host-sampled program never sees. Only when the cohort is
+        # actually samplable; the driver refuses loudly otherwise (the
+        # PR-6 behavior)
         from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
             cohort as cohort_mod)
         return cohort_mod.cohort_feasible(cfg)

@@ -45,6 +45,13 @@ def run_name(cfg) -> str:
         # in the churn process must not share a run dir
         churn = (f"-chrn:a{cfg.churn_available}p{cfg.churn_period}"
                  f"s{cfg.churn_seed}")
+    traffic = ""
+    if cfg.traffic_enabled:
+        # diurnal-traffic cell (ISSUE 17): same collision rule; "flat"
+        # stays cell-free so every historical run dir is preserved
+        traffic = (f"-tfc:{cfg.traffic}p{cfg.traffic_peak_frac}"
+                   f"t{cfg.traffic_trough_frac}d{cfg.traffic_day_rounds}"
+                   f"s{cfg.traffic_seed}")
     cohort = ""
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
@@ -105,7 +112,7 @@ def run_name(cfg) -> str:
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
             f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}"
-            f"{faults}{churn}{cohort}{atk}{agm}{layout}")
+            f"{faults}{churn}{traffic}{cohort}{atk}{agm}{layout}")
 
 
 class NullWriter:

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Bank-build throughput bench (ISSUE 17): clients/sec for the sharded
+client-bank build at a pinned population x worker cell.
+
+Builds a synthetic-label bank into a throwaway directory, times the
+build, and writes a bare bench-result artifact the perf trajectory gate
+folds into its own ``bank_build_*`` comparability group
+(obs/trajectory.py; scripts/bench_trajectory.py --fold)::
+
+    python scripts/bench_bank_build.py --population 1000000 --workers 4 \
+        --out bank_build_bench.json
+
+The pinned flagship cell is 1M clients / 4 workers on CPU; the
+acceptance ladder also runs ``--workers 1`` on the same population so
+the parallel speedup (>=3x at 1M/4w) is measured, not assumed. The
+content_sha of every run at the same population is printed so the
+ladder doubles as a cross-worker determinism check. numpy-only — no jax
+import, runs on any host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.data import (  # noqa: E402
+    bank as bank_mod)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Time a client-bank build and emit a trajectory "
+                    "artifact (metric bank_build_clients_per_sec)")
+    ap.add_argument("--population", type=int, default=1_000_000)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--partitioner", default="dirichlet",
+                    choices=["dirichlet", "pathological", "label_shards"])
+    ap.add_argument("--samples_per_client", type=int, default=64)
+    ap.add_argument("--shard_clients", type=int, default=65536)
+    ap.add_argument("--n_samples", type=int, default=60_000,
+                    help="synthetic base-dataset size (labels array)")
+    ap.add_argument("--n_classes", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="bank_build_bench.json",
+                    help="artifact path ('' = print only)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the built bank dir (default: delete)")
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    labels = rng.integers(0, args.n_classes,
+                          size=args.n_samples).astype(np.int64)
+    root = tempfile.mkdtemp(prefix="bank_bench_")
+    bank_dir = os.path.join(root, "bank")
+    t0 = time.perf_counter()
+    bank = bank_mod.build_bank(
+        bank_dir, labels, population=args.population,
+        partitioner=args.partitioner,
+        samples_per_client=args.samples_per_client,
+        dirichlet_alpha=0.5, classes_per_client=2, seed=args.seed,
+        n_classes=args.n_classes, shard_clients=args.shard_clients,
+        workers=args.workers)
+    wall = time.perf_counter() - t0
+    cps = args.population / wall
+    print(f"[bench_bank_build] {args.population:,} clients / "
+          f"{args.workers} worker(s): {wall:.2f}s = {cps:,.0f} "
+          f"clients/sec (content_sha {bank.meta['content_sha'][:16]})")
+    artifact = {
+        "metric": "bank_build_clients_per_sec",
+        "value": round(cps, 2),
+        "device": "cpu",
+        "bench_config": f"bank_{args.partitioner}",
+        "dtype": "i64",
+        "population": args.population,
+        "workers": args.workers,
+        "shard_clients": args.shard_clients,
+        "wall_s": round(wall, 3),
+        "content_sha": bank.meta["content_sha"],
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[bench_bank_build] artifact -> {args.out}")
+    bank.close()
+    if not args.keep:
+        shutil.rmtree(root, ignore_errors=True)
+    else:
+        print(f"[bench_bank_build] bank kept at {bank_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,8 +80,11 @@ def main(argv=None) -> int:
         verdict = "PASS" if r["pass"] else "FAIL"
         value = "—" if r["value"] is None else f"{r['value']:.4f}"
         note = f"  ({r['note']})" if r.get("note") else ""
-        # fleet points judge cells/hour; everything else rounds/sec
-        unit = ("c/h" if (r.get("group") or "").startswith("fleet")
+        # fleet points judge cells/hour, bank-build points clients/sec;
+        # everything else rounds/sec
+        group = r.get("group") or ""
+        unit = ("c/h" if group.startswith("fleet")
+                else "c/s" if group.startswith("bank_build")
                 else "r/s")
         print(f"[trajectory] {r['label']:>8}  {value:>10} {unit}  "
               f"{verdict}{note}")

@@ -326,6 +326,53 @@ else
     say "WARN: scheduler A/B sweep rc=$?"
 fi
 
+say "step 6e: 10M diurnal flagship (ISSUE 17 — BENCH_NOTES r18)"
+# The planet-scale cell: a 10M-client diurnal-traffic cohort run on the
+# real chip, plus the multi-core bank-build ladder the 1-core dev
+# container cannot measure. Three parts: (1) build throughput at
+# 1M/{1,4} workers and 10M/4 (sha printed by the bench doubles as the
+# cross-worker determinism check; each artifact folds into its own
+# bank_build trajectory group); (2) the 10M diurnal training run — the
+# round program never sees the population size, so rounds/sec should
+# match the 1M twin and host RSS stay flat (streamed pread gathers);
+# (3) the diurnal sync-vs-buffered RLR A/B filling the r18 table.
+BANK_OK=0
+if python scripts/bench_bank_build.py --population 1000000 --workers 1 \
+        --out BENCH_TPU_r05_bank_1m_w1.json >>"$LOG" 2>&1 \
+   && python scripts/bench_bank_build.py --population 1000000 --workers 4 \
+        --out BENCH_TPU_r05_bank_1m_w4.json >>"$LOG" 2>&1 \
+   && python scripts/bench_bank_build.py --population 10000000 --workers 4 \
+        --out BENCH_TPU_r05_bank_10m_w4.json >>"$LOG" 2>&1; then
+    python scripts/bench_trajectory.py \
+        --fold BENCH_TPU_r05_bank_*.json --write >>"$LOG" 2>&1 \
+        || say "WARN: bank_build trajectory fold failed"
+    python - <<'PY' >>"$LOG" 2>&1 && BANK_OK=1
+import json
+w1 = json.load(open("BENCH_TPU_r05_bank_1m_w1.json"))
+w4 = json.load(open("BENCH_TPU_r05_bank_1m_w4.json"))
+assert w1["content_sha"] == w4["content_sha"], "parallel build diverged!"
+speedup = w4["value"] / w1["value"]
+print(f"[r18] 1M build: {w1['value']:,.0f} c/s serial vs "
+      f"{w4['value']:,.0f} c/s 4-worker = {speedup:.2f}x (sha equal)")
+assert speedup >= 3.0, "4-worker build under 3x — the r18 acceptance"
+PY
+    if [ "$BANK_OK" -eq 1 ]; then SUCCESSES=$((SUCCESSES + 1)); fi
+else
+    say "WARN: bank-build ladder rc=$?"
+fi
+if python federated.py --data synthetic --num_agents 10000000 \
+        --cohort_size 64 --bank_build_workers 4 --traffic diurnal \
+        --partitioner dirichlet --bs 16 --local_ep 1 \
+        --synth_train_size 2048 --synth_val_size 64 --eval_bs 64 \
+        --rounds 8 --snap 4 --num_corrupt 1000 --poison_frac 0.5 \
+        --robustLR_threshold 3 --seed 5 --no_tensorboard \
+        --log_dir logs/diurnal_10m >>"$LOG" 2>&1; then
+    say "10M diurnal cohort run OK (rounds/sec + RSS -> r18 table)"
+    SUCCESSES=$((SUCCESSES + 1))
+else
+    say "WARN: 10M diurnal run rc=$? (r18 table stays unfilled)"
+fi
+
 say "step 7/7: figures refresh"
 # NOT counted in SUCCESSES: plot_curves re-renders from a pre-existing
 # results.json, so it succeeds even when every measurement step failed —

@@ -171,12 +171,13 @@ _SUBPROC_BUILD = textwrap.dedent("""
     from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
         bank as bank_mod)
     part, pop, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    workers = int(sys.argv[4]) if len(sys.argv) > 4 else 1
     labels = np.random.default_rng(0).integers(
         0, 10, size=2000).astype(np.int64)
     bank = bank_mod.build_bank(
         out_dir, labels, population=pop, partitioner=part,
         samples_per_client=16, seed=11, shard_clients=4096,
-        log=lambda *_: None)
+        workers=workers, log=lambda *_: None)
     probe = {str(c): np.asarray(bank.client_indices(c)).tolist()
              for c in (0, 4095, 4096, pop - 1)}
     print(json.dumps({"sha": bank.meta["content_sha"], "probe": probe}))
@@ -208,6 +209,157 @@ def test_100k_partition_fingerprint_stable_across_processes(
     for cid, idx in got["probe"].items():
         np.testing.assert_array_equal(
             np.asarray(here.client_indices(int(cid))), np.asarray(idx))
+
+
+# -------------------------------------------- parallel build (ISSUE 17) ---
+
+@pytest.mark.parametrize("partitioner,pop,shard_clients",
+                         [("dirichlet", 600, 37),
+                          ("pathological", 600, 37),
+                          ("label_shards", 50, 7)])
+def test_parallel_build_bitwise_matches_serial(tmp_path, partitioner,
+                                               pop, shard_clients):
+    """The sharded parallel build is a pure re-partition of the work:
+    same content_sha, same offsets, same per-client rows as the serial
+    build — workers is an IO knob like shard_clients, excluded from
+    bank_key. (label_shards runs a smaller population: it deals whole
+    class-shards, bounding clients by dataset size.)"""
+    labels = _labels(1000)
+    kw = dict(population=pop, partitioner=partitioner,
+              samples_per_client=24, seed=3,
+              shard_clients=shard_clients, log=lambda *_: None)
+    ser = bank_mod.build_bank(str(tmp_path / "ser"), labels, workers=1,
+                              **kw)
+    par = bank_mod.build_bank(str(tmp_path / "par"), labels, workers=4,
+                              **kw)
+    assert par.meta["content_sha"] == ser.meta["content_sha"]
+    assert par.meta["key"] == ser.meta["key"]
+    np.testing.assert_array_equal(np.asarray(par.offsets),
+                                  np.asarray(ser.offsets))
+    for cid in (0, shard_clients - 1, shard_clients, pop - 1):
+        np.testing.assert_array_equal(par.client_indices(cid),
+                                      ser.client_indices(cid))
+
+
+def test_build_workers_excluded_from_bank_key():
+    """--bank_build_workers joins shard_clients in the layout-excluded
+    set: it cannot change stored content, so a worker-count change must
+    reuse the bank (and is runtime provenance / compile-cache-excluded)."""
+    import inspect
+    assert "workers" not in inspect.signature(
+        bank_mod.bank_key).parameters
+    from defending_against_backdoors_with_robust_learning_rate_tpu.utils.compile_cache import (
+        EXCLUDED_FIELDS)
+    assert "bank_build_workers" in EXCLUDED_FIELDS
+    assert FIELD_PROVENANCE["bank_build_workers"] == "runtime"
+
+
+@pytest.mark.parametrize("partitioner", ["dirichlet", "pathological"])
+def test_100k_parallel_build_fingerprint_matches_serial(
+        tmp_path, partitioner):
+    """ISSUE 17 tentpole pin at CI scale: a 4-worker parallel build in a
+    DIFFERENT process (different shard layout too) lands the same
+    content_sha and the same probed per-client rows as the serial
+    in-process build."""
+    pop = 100_000
+    labels = np.random.default_rng(0).integers(
+        0, 10, size=2000).astype(np.int64)
+    here = bank_mod.build_bank(
+        str(tmp_path / "here"), labels, population=pop,
+        partitioner=partitioner, samples_per_client=16, seed=11,
+        shard_clients=65536, workers=1, log=lambda *_: None)
+    out = subprocess.run(
+        [sys.executable, "-c", _SUBPROC_BUILD, partitioner, str(pop),
+         str(tmp_path / "there"), "4"],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    assert got["sha"] == here.meta["content_sha"]
+    for cid, idx in got["probe"].items():
+        np.testing.assert_array_equal(
+            np.asarray(here.client_indices(int(cid))), np.asarray(idx))
+
+
+@pytest.mark.slow  # ~1 min: the full ISSUE 17 acceptance pin at 1M
+@pytest.mark.parametrize("partitioner", ["dirichlet", "pathological"])
+def test_1m_parallel_build_fingerprint_matches_serial(
+        tmp_path, partitioner):
+    """The acceptance-scale twin of the 100k pin: 1M clients, 4 workers
+    cross-process vs serial in-process — content_sha and probed rows
+    bitwise identical."""
+    pop = 1_000_000
+    labels = np.random.default_rng(0).integers(
+        0, 10, size=2000).astype(np.int64)
+    here = bank_mod.build_bank(
+        str(tmp_path / "here"), labels, population=pop,
+        partitioner=partitioner, samples_per_client=16, seed=11,
+        shard_clients=65536, workers=1, log=lambda *_: None)
+    out = subprocess.run(
+        [sys.executable, "-c", _SUBPROC_BUILD, partitioner, str(pop),
+         str(tmp_path / "there"), "4"],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    assert got["sha"] == here.meta["content_sha"]
+    for cid, idx in got["probe"].items():
+        np.testing.assert_array_equal(
+            np.asarray(here.client_indices(int(cid))), np.asarray(idx))
+
+
+def test_streamed_gather_bitwise_matches_memmap(tmp_path):
+    """The streamed (pread) row fetch and gather are bitwise the memmap
+    path — same bytes, same dtype, just no resident shard pages."""
+    labels = _labels(1000)
+    rng = np.random.default_rng(4)
+    images = rng.random((1000, 8, 8, 1)).astype(np.float32)
+    bank = bank_mod.build_bank(
+        str(tmp_path / "b"), labels, population=500,
+        partitioner="dirichlet", samples_per_client=24, seed=3,
+        shard_clients=64, log=lambda *_: None)
+    for cid in (0, 63, 64, 499):
+        np.testing.assert_array_equal(bank.read_client_indices(cid),
+                                      bank.client_indices(cid))
+    ids = rng.integers(0, 500, size=32)
+    a = bank.gather(ids, images, labels.astype(np.int32), 24,
+                    streamed=True)
+    b = bank.gather(ids, images, labels.astype(np.int32), 24,
+                    streamed=False)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    bank.close()  # releases pread fds; gathers after close reopen lazily
+    np.testing.assert_array_equal(bank.read_client_indices(0),
+                                  bank.client_indices(0))
+
+
+def test_bank_build_emits_typed_events(tmp_path):
+    """A build under an installed obs ledger records its lifecycle:
+    build_start -> per-worker shard_done -> published, with the
+    content_sha on the published record (fleet consoles can watch a
+    multi-hour 100M build without scraping prints)."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        events as obs_events)
+    path = str(tmp_path / "events.jsonl")
+    ledger = obs_events.EventLedger(path, run="t", corr="c")
+    prev = obs_events.install(ledger)
+    try:
+        labels = _labels(500)
+        bank = bank_mod.build_bank(
+            str(tmp_path / "b"), labels, population=100,
+            partitioner="dirichlet", samples_per_client=16,
+            shard_clients=25, workers=2, log=lambda *_: None)
+    finally:
+        obs_events.install(prev)
+        ledger.close()
+    recs = obs_events.read_events(path)
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "bank/build_start"
+    assert kinds.count("bank/shard_done") == 2
+    assert kinds[-1] == "bank/published"
+    pub = recs[-1]
+    assert pub["content_sha"] == bank.meta["content_sha"]
+    assert pub["workers"] == 2
 
 
 _SUBPROC_RSS = textwrap.dedent("""
@@ -329,9 +481,76 @@ def test_cohort_shortfall_pads_with_inactive_slots():
 
 
 def test_oversample_cap_is_loud():
+    """The refusal now fires only past MAX_CANDIDATES x MAX_DRAW_CHUNKS
+    (ISSUE 17): a paper-scale cohort over 1M clients — which the old
+    single-matrix cap refused — chunks instead; a deep-churn cohort whose
+    oversample exceeds even the chunked budget still refuses loudly."""
     cfg = _cfg(num_agents=10**6, cohort_sampled="on", cohort_size=4096)
+    c, n_chunks = cohort_mod.draw_plan(cfg)       # used to raise
+    assert n_chunks == 2 and c == cohort_mod.MAX_CANDIDATES
+    deep = cfg.replace(churn_available=0.005, churn_period=4)
     with pytest.raises(ValueError, match="MAX_CANDIDATES"):
-        cohort_mod.oversample_count(cfg)
+        cohort_mod.oversample_count(deep)
+    assert not cohort_mod.cohort_feasible(deep)
+
+
+def test_chunked_draw_samples_below_old_cap():
+    """Deep churn pushes the oversample past one candidate matrix: the
+    chunked rejection resample still fills the cohort from the present
+    set — active slots are churn-present, deduped, in range — where the
+    old cap refused the config outright."""
+    cfg = _cfg(num_agents=100_000, cohort_sampled="on", cohort_size=64,
+               churn_available=0.01, churn_period=4)
+    c, n_chunks = cohort_mod.draw_plan(cfg)
+    assert n_chunks > 1                            # genuinely chunked
+    filled = 0
+    for rnd in (1, 2, 9):
+        ids, active = cohort_mod.sample_cohort_host(cfg, rnd)
+        assert ids.shape == (64,) and ids.dtype == np.int32
+        assert ids.min() >= 0 and ids.max() < 100_000
+        live = ids[active]
+        assert len(set(live.tolist())) == len(live)
+        present = np.asarray(churn_mod.active_slots(
+            cfg, jnp.asarray(ids), rnd))
+        assert not np.any(active & ~present)
+        filled += int(active.sum())
+    # 1% of 100k = ~1000 present clients; 4 chunks (16384 candidates)
+    # make a 64-cohort shortfall vanishingly unlikely
+    assert filled == 3 * 64
+
+
+def test_chunked_draw_host_mirror_matches_traced():
+    """The chunked draw keeps the host-mirror contract: the traced
+    in-program draw and the driver's host sampler are the same jax ops,
+    bit-identical in the multi-chunk regime too."""
+    cfg = _cfg(num_agents=50_000, cohort_sampled="on", cohort_size=32,
+               churn_available=0.01, churn_period=4)
+    assert cohort_mod.draw_plan(cfg)[1] > 1
+    traced = jax.jit(lambda r: cohort_mod.sample_cohort(cfg, r))
+    for rnd in (1, 7, 173):
+        ids_t, act_t = traced(jnp.int32(rnd))
+        ids_h, act_h = cohort_mod.sample_cohort_host(cfg, rnd)
+        np.testing.assert_array_equal(np.asarray(ids_t), ids_h)
+        np.testing.assert_array_equal(np.asarray(act_t), act_h)
+
+
+def test_single_chunk_path_unchanged_by_chunking():
+    """Every config that fit under the old cap keeps its exact draw: the
+    single-chunk path is the historical op sequence, so adding the
+    chunked machinery must not perturb a paper-scale cohort."""
+    cfg = _cfg(num_agents=2048, cohort_sampled="on", cohort_size=8)
+    assert cohort_mod.draw_plan(cfg) == (
+        cohort_mod.oversample_count(cfg), 1)
+    ids, active = cohort_mod.sample_cohort_host(cfg, 1)
+    # pinned draw: regenerate from the raw op sequence
+    k = jax.random.fold_in(cohort_mod.cohort_key(cfg), 1)
+    C = cohort_mod.oversample_count(cfg)
+    cand = jax.random.randint(k, (C,), 0, 2048, dtype=jnp.int32)
+    eq = cand[:, None] == cand[None, :]
+    first = jnp.argmax(eq, axis=1) == jnp.arange(C)
+    order = jnp.argsort(jnp.where(first, 0, 1) * C + jnp.arange(C))[:8]
+    np.testing.assert_array_equal(ids, np.asarray(cand[order]))
+    np.testing.assert_array_equal(active, np.asarray(first[order]))
 
 
 def test_cohort_mode_selection():

@@ -1,0 +1,270 @@
+"""Trace-shaped diurnal traffic (ISSUE 17, data/traffic.py).
+
+Covers the three contracts the traffic model inherits from churn:
+
+- **purity**: every draw is a pure function of (client id, round) and
+  the `program` traffic fields — deterministic, order-independent,
+  host-mirrorable, disjoint from the training/cohort/churn streams;
+- **composition**: cohorts are sampled from the traffic-present set,
+  presence ANDs into the participation mask, and the buffered latency
+  draw turns heavy-tailed under ``--traffic diurnal`` while the host
+  mirror stays bit-identical;
+- **flat is free**: ``--traffic flat`` (the default) is bitwise today's
+  path — no run_name cell, no round lead arg, the historical uniform
+  latency randint, zero new program outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defending_against_backdoors_with_robust_learning_rate_tpu import train
+from defending_against_backdoors_with_robust_learning_rate_tpu.config import (
+    FIELD_PROVENANCE, Config)
+from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+    cohort as cohort_mod, traffic as traffic_mod)
+from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+    model as fmodel)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+    buffered)
+from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+    step_takes_round)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.compile_cache import (
+    EXCLUDED_FIELDS)
+from defending_against_backdoors_with_robust_learning_rate_tpu.utils.metrics import (
+    NullWriter, run_name)
+
+
+def _cfg(**kw):
+    kw.setdefault("data", "synthetic")
+    kw.setdefault("bs", 16)
+    kw.setdefault("local_ep", 1)
+    return Config(**kw)
+
+
+def _diurnal(**kw):
+    return _cfg(traffic="diurnal", **kw)
+
+
+# ------------------------------------------------------------ purity ------
+
+def test_present_slots_pure_of_client_and_round():
+    """Presence is a per-client pure function: deterministic across
+    calls, identical traced vs host, and equivariant under reordering
+    the id vector (no positional state)."""
+    cfg = _diurnal(num_agents=512)
+    ids = jnp.arange(256, dtype=jnp.int32)
+    a = np.asarray(traffic_mod.present_slots(cfg, ids, 5))
+    b = np.asarray(traffic_mod.present_slots(cfg, ids, 5))
+    np.testing.assert_array_equal(a, b)
+    traced = jax.jit(lambda r: traffic_mod.present_slots(cfg, ids, r))
+    np.testing.assert_array_equal(np.asarray(traced(jnp.int32(5))), a)
+    perm = np.random.default_rng(0).permutation(256)
+    np.testing.assert_array_equal(
+        np.asarray(traffic_mod.present_slots(cfg, ids[perm], 5)),
+        a[perm])
+
+
+def test_present_varies_by_round_and_traffic_seed_only():
+    """The (client, round) chain: different rounds and different
+    ``traffic_seed`` values draw different masks, while the training
+    seed, cohort seed and churn seed leave the traffic stream untouched
+    (its fold_in tag keeps it disjoint)."""
+    cfg = _diurnal(num_agents=2048)
+    ids = jnp.arange(2048, dtype=jnp.int32)
+    m1 = np.asarray(traffic_mod.present_slots(cfg, ids, 1))
+    assert not np.array_equal(
+        m1, np.asarray(traffic_mod.present_slots(cfg, ids, 2)))
+    assert not np.array_equal(
+        m1, np.asarray(traffic_mod.present_slots(
+            cfg.replace(traffic_seed=1), ids, 1)))
+    for indep in (cfg.replace(seed=123), cfg.replace(cohort_seed=7)):
+        np.testing.assert_array_equal(
+            m1, np.asarray(traffic_mod.present_slots(indep, ids, 1)))
+
+
+def test_availability_curve_and_mean():
+    """The raised cosine peaks at local t=0, troughs half a day later,
+    stays inside [trough, peak], and day-averages to the midpoint (the
+    cohort oversample's scale); flat mode reports full availability."""
+    cfg = _diurnal(traffic_peak_frac=0.8, traffic_trough_frac=0.1,
+                   traffic_day_rounds=64)
+    t = jnp.arange(64)
+    curve = np.asarray(traffic_mod.availability_curve(cfg, t))
+    assert curve[0] == pytest.approx(0.8, abs=1e-6)
+    assert curve[32] == pytest.approx(0.1, abs=1e-6)
+    assert curve.min() >= 0.1 - 1e-6 and curve.max() <= 0.8 + 1e-6
+    assert curve.mean() == pytest.approx(0.45, abs=1e-3)
+    assert traffic_mod.mean_available(cfg) == pytest.approx(0.45)
+    assert traffic_mod.mean_available(_cfg()) == 1.0
+
+
+def test_timezones_spread_presence_across_population():
+    """Seeded per-client timezone offsets keep the wall-clock-reachable
+    fraction near the day-averaged mean (the population never troughs
+    in unison) — and the host census agrees with the mask."""
+    cfg = _diurnal(num_agents=4096)
+    mean = traffic_mod.mean_available(cfg)
+    for rnd in (1, 17, 40):
+        n = traffic_mod.census(cfg, rnd)
+        assert abs(n / 4096 - mean) < 0.1, (rnd, n)
+        mask = np.asarray(traffic_mod.present_slots(
+            cfg, jnp.arange(4096), rnd))
+        assert n == int(mask.sum())
+
+
+# ------------------------------------------------------- composition ------
+
+def test_cohort_sampled_from_traffic_present_set():
+    """Every ACTIVE cohort slot holds a traffic-present client (the
+    churn contract, extended): absent clients are ineligible, and the
+    oversample scales by the diurnal mean availability."""
+    cfg = _diurnal(num_agents=4096, cohort_sampled="on", cohort_size=16)
+    assert cohort_mod.availability(cfg) == pytest.approx(
+        traffic_mod.mean_available(cfg))
+    assert cohort_mod.oversample_count(cfg) > cohort_mod.oversample_count(
+        _cfg(num_agents=4096, cohort_sampled="on", cohort_size=16))
+    seen_active = 0
+    for rnd in range(1, 6):
+        ids, active = cohort_mod.sample_cohort_host(cfg, rnd)
+        present = np.asarray(traffic_mod.present_slots(
+            cfg, jnp.asarray(ids), rnd))
+        assert not np.any(active & ~present)
+        seen_active += int(active.sum())
+    assert seen_active > 0
+
+
+def test_diurnal_latency_host_mirror_bit_identical():
+    """The buffered arrival draw under --traffic diurnal: the traced
+    in-program derivation (fault stream -> straggler flags -> log-normal
+    staleness) equals fl/buffered.host_latency_draw bit for bit."""
+    cfg = _diurnal(num_agents=8, straggler_rate=0.7,
+                   async_max_staleness=5)
+    m = cfg.agents_per_round
+
+    def draw(rnd):
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), rnd)
+        k_noise = jax.random.split(key, 3)[2]
+        k_strag = jax.random.split(fmodel.fault_key(k_noise), 3)[1]
+        strag = jax.random.uniform(k_strag, (m,)) < cfg.straggler_rate
+        return buffered.latency(cfg, k_noise, strag)
+
+    traced = jax.jit(draw)
+    for rnd in (1, 2, 9):
+        np.testing.assert_array_equal(
+            np.asarray(traced(jnp.int32(rnd))),
+            buffered.host_latency_draw(cfg, rnd, seed=cfg.seed))
+
+
+def test_latency_quantile_heavy_tailed_and_clipped():
+    """The log-normal staleness map: int32 in [1, S], monotone in the
+    uniform draw, and genuinely heavy-tailed — most uploads land next
+    tick (far above the uniform draw's 1/S share) with a real tail at
+    the staleness cap."""
+    cfg = _diurnal(traffic_latency_sigma=0.8)
+    u = jnp.linspace(0.001, 0.999, 4096)
+    t = np.asarray(traffic_mod.latency_quantile(cfg, u, 8))
+    assert t.dtype == np.int32
+    assert t.min() == 1 and t.max() == 8
+    assert np.all(np.diff(t) >= 0)               # monotone quantile map
+    assert (t == 1).mean() >= 0.45               # uniform would give 1/8
+    assert (t == 8).sum() > 0
+
+
+def test_flat_latency_is_bitwise_historical():
+    """--traffic flat keeps the exact historical uniform randint: the
+    draw equals a from-scratch replay of the pre-ISSUE-17 op sequence."""
+    cfg = _cfg(num_agents=8, straggler_rate=0.7, async_max_staleness=5)
+    assert not cfg.traffic_enabled
+    m, S = cfg.agents_per_round, cfg.async_max_staleness
+    for rnd in (1, 3, 8):
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), rnd)
+        k_noise = jax.random.split(key, 3)[2]
+        fk = fmodel.fault_key(k_noise)
+        strag = jax.random.uniform(jax.random.split(fk, 3)[1],
+                                   (m,)) < cfg.straggler_rate
+        k = jax.random.fold_in(fk, buffered.ASYNC_KEY_TAG)
+        t = jax.random.randint(k, (m,), 1, S + 1)
+        expect = np.asarray(jnp.where(strag, t, 0), np.int32)
+        np.testing.assert_array_equal(
+            buffered.host_latency_draw(cfg, rnd, seed=cfg.seed), expect)
+
+
+# ---------------------------------------------------- config surface ------
+
+def test_traffic_config_surface():
+    """The new fields are all `program` provenance (they shape the
+    traced draw — the fail-closed audit's contract), none leak into the
+    compile-cache exclusion set, the run_name grows a traffic cell only
+    when diurnal, and the fold_in tag is disjoint from every sibling
+    stream."""
+    for f in ("traffic", "traffic_seed", "traffic_peak_frac",
+              "traffic_trough_frac", "traffic_day_rounds",
+              "traffic_latency_sigma"):
+        assert FIELD_PROVENANCE[f] == "program", f
+        assert f not in EXCLUDED_FIELDS, f
+    assert FIELD_PROVENANCE["bank_build_workers"] == "runtime"
+    assert not _cfg().traffic_enabled
+    assert _diurnal().traffic_enabled
+    flat, diur = _cfg(num_agents=8), _diurnal(num_agents=8)
+    assert "-tfc:" not in run_name(flat)
+    assert "-tfc:diurnal" in run_name(diur)
+    for field, val in (("traffic_seed", 9), ("traffic_peak_frac", 0.6),
+                       ("traffic_trough_frac", 0.2),
+                       ("traffic_day_rounds", 32)):
+        assert run_name(diur) != run_name(diur.replace(**{field: val}))
+    from defending_against_backdoors_with_robust_learning_rate_tpu.service.churn import (
+        CHURN_KEY_TAG)
+    tags = {traffic_mod.TRAFFIC_KEY_TAG, CHURN_KEY_TAG,
+            cohort_mod.COHORT_KEY_TAG, buffered.ASYNC_KEY_TAG}
+    assert len(tags) == 4
+
+
+def test_step_takes_round_with_traffic():
+    assert not step_takes_round(_cfg(num_agents=8))
+    assert step_takes_round(_diurnal(num_agents=8))
+
+
+# ------------------------------------------------------------ driver ------
+
+def test_driver_diurnal_cohort_e2e(tmp_path, capsys):
+    """train.run end-to-end at cohort scale under diurnal traffic: the
+    bank builds, cohorts are drawn from the present set, the round
+    program composes the traffic mask, and the run completes."""
+    cfg = _diurnal(num_agents=4096, cohort_size=4,
+                   partitioner="dirichlet", rounds=2, snap=2,
+                   num_corrupt=64, poison_frac=0.5,
+                   data_dir=str(tmp_path / "nodata"),
+                   log_dir=str(tmp_path / "logs"), compile_cache=False,
+                   tensorboard=False, spans=False, heartbeat=False)
+    train.run(cfg, writer=NullWriter())
+    out = capsys.readouterr().out
+    assert "[cohort] population 4,096 clients -> 4-client cohorts" in out
+
+
+def test_host_sampled_traffic_routes_to_cohort(tmp_path, capsys,
+                                               monkeypatch):
+    """A host-sampled run under diurnal traffic routes through the
+    cohort program (the churn-reroute contract extended: the presence
+    draw needs client ids the host-sampled program never sees)."""
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    cfg = _diurnal(num_agents=8, rounds=2, snap=2,
+                   data_dir=str(tmp_path / "nodata"),
+                   log_dir=str(tmp_path / "logs"), compile_cache=False,
+                   tensorboard=False, spans=False, heartbeat=False)
+    train.run(cfg, writer=NullWriter())
+    out = capsys.readouterr().out
+    assert "host-sampled + traffic: cohorts are sampled" in out
+    assert "traffic-present set" in out
+
+
+def test_host_traffic_with_cohort_off_still_refuses(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setattr(train, "DEVICE_RESIDENT_BYTES", 0)
+    cfg = _diurnal(num_agents=8, rounds=2, snap=2, cohort_sampled="off",
+                   data_dir=str(tmp_path / "nodata"),
+                   log_dir=str(tmp_path / "logs"), compile_cache=False,
+                   tensorboard=False, spans=False, heartbeat=False)
+    with pytest.raises(ValueError, match="host-sampled \\+ traffic"):
+        train.run(cfg, writer=NullWriter())
