@@ -1190,6 +1190,9 @@ def main():
            "unit": "rounds/sec",
            "compile_s": round(compile_s, 1),
            "chain": chain,
+           # blocks*chain = steady rounds: obs/explain.py normalizes the
+           # span totals per round with it when diffing two artifacts
+           "blocks": args.blocks,
            "rng_impl": rng_impl,
            "bench_config": args.bench_config,
            "dtype": cfg.dtype,

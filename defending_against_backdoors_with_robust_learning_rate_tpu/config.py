@@ -386,6 +386,24 @@ class Config:
                                     # record in <run_dir>/events.jsonl;
                                     # off arms nothing and the metrics
                                     # stream is bit-identical
+    flight: str = "on"              # on | off — the incident flight
+                                    # recorder (obs/flight.py): a bounded
+                                    # per-round ring of span durations /
+                                    # dispatch gaps / drain depth / HBM
+                                    # stats, streamed crash-exactly to
+                                    # <run_dir>/flight.jsonl and dumped
+                                    # atomically to flight.json on any
+                                    # warn/error incident; host-side only,
+                                    # training is bit-identical either way
+    trigger_profile: str = "off"    # on | off — anomaly-triggered
+                                    # profiling (obs/trigger.py): a flight-
+                                    # window span z-score or a supervisor/
+                                    # health incident arms the round
+                                    # profiler for a bounded capture (max
+                                    # 2/run) and ledgers the device split
+                                    # as obs/trigger_* events. Off by
+                                    # default: arming is timing-dependent,
+                                    # so byte-identity drills keep it off
     metrics_port: int = 0           # >0: serve GET /metrics (Prometheus
                                     # exposition text, obs/export.py) on
                                     # this port from the service driver;
@@ -643,6 +661,8 @@ FIELD_PROVENANCE = {
     "heartbeat": "runtime",
     "status_file": "runtime",
     "events": "runtime",          # ledger IO only; never read in a trace
+    "flight": "runtime",          # ring buffer + stream IO only
+    "trigger_profile": "runtime",  # arms the profiler; never in a trace
     "metrics_port": "runtime",    # exporter transport knobs
     "metrics_textfile": "runtime",
     "data_dir": "runtime",
@@ -1038,6 +1058,17 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                         "lifecycle transition as a typed, seq-numbered "
                         "record in <run_dir>/events.jsonl (off arms "
                         "nothing; the metrics stream is bit-identical)")
+    p.add_argument("--flight", choices=("on", "off"), default=d.flight,
+                   help="incident flight recorder (obs/flight.py): "
+                        "bounded per-round ring streamed crash-exactly "
+                        "to <run_dir>/flight.jsonl, snapshotted to "
+                        "flight.json on any incident")
+    p.add_argument("--trigger_profile", choices=("on", "off"),
+                   default=d.trigger_profile,
+                   help="anomaly-triggered profiling (obs/trigger.py): "
+                        "a flight-window z-score or an incident arms "
+                        "the round profiler for a bounded capture "
+                        "(max 2/run) and ledgers the device split")
     p.add_argument("--metrics_port", type=int, default=d.metrics_port,
                    help=">0: serve GET /metrics (Prometheus exposition "
                         "text) on this port from the service driver "

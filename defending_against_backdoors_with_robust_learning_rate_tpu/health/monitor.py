@@ -286,6 +286,10 @@ class HealthLadder:
         # in-memory (deliberately unpersisted): recovery re-entries THIS
         # process has performed — the serve() recursion-depth bound
         self.reentries = 0
+        # optional incident hook, on_rung(rung, rnd): the service driver
+        # wires the flight-recorder snapshot + profile trigger here so a
+        # rung leaves its evidence even with the event ledger off
+        self.on_rung = None
         # the state file lives at the log_dir root (the status.json /
         # chaos_state.json convention, where external watchers look),
         # so it carries the run's identity: a DIFFERENT experiment
@@ -405,6 +409,11 @@ class HealthLadder:
                         severity="error" if rung == "halt" else "warn",
                         round=rnd, rung=rung,
                         incidents=self.state["incidents"])
+        if self.on_rung is not None:
+            try:
+                self.on_rung(rung, rnd)
+            except Exception:
+                pass  # observability must never take down the run
         if sup is not None:
             # a counted, journaled status.json phase per transition —
             # recovery is observable, not inferred from silence

@@ -53,6 +53,29 @@ The fleet plane (ISSUE 15) — cross-run, service-level observability:
 - `obs.constants`  `NON_TIMING_PREFIXES`, the single-sourced exclusion
                    list every crash-exact metrics byte-compare filters
                    on.
+
+The forensics layer (ISSUE 18) — what happened, why, and what changed:
+
+- `obs.flight`     the always-on incident flight recorder: a bounded
+                   per-round ring of span durations, dispatch gaps,
+                   drain depth, async buffer fill and HBM watermarks
+                   streamed to `<run_dir>/flight.jsonl` with ledger-
+                   grade crash-exact semantics, snapshotted atomically
+                   to `flight.json` on any incident (health rung,
+                   supervisor retry/wedge, chaos action, clean exit).
+- `obs.trigger`    budgeted anomaly-triggered profiling: a span-p95
+                   z-score over the flight window (or a monitor/
+                   supervisor incident) arms `obs.attribution`'s
+                   RoundProfiler for N steady rounds, max 2 captures
+                   per run (`--trigger_profile on|off`), attaching the
+                   device split as `obs/trigger_*` ledger events and
+                   exporter gauges.
+- `obs.explain`    cross-run regression forensics: diff two run dirs or
+                   bench artifacts into a per-span/per-phase delta
+                   table (compile vs steady vs drain vs eval vs
+                   collective share) with a classified verdict —
+                   `scripts/bench_trajectory.py --explain` and the
+                   auto-explain on a trajectory gate FAIL.
 """
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs.heartbeat import (  # noqa: F401

@@ -43,9 +43,9 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
 
 TAIL_BYTES = 1 << 16
 COLUMNS = ("run", "phase", "round", "rps", "val_acc", "ledger_seq",
-           "last_event", "warn_err", "age")
+           "last_event", "incident", "warn_err", "age")
 HEADERS = ("RUN", "PHASE", "ROUND", "R/S", "VAL", "SEQ", "LAST EVENT",
-           "W/E", "AGE")
+           "INCIDENT", "W/E", "AGE")
 
 
 def _tail_lines(path: str, max_bytes: int = TAIL_BYTES) -> List[str]:
@@ -128,6 +128,17 @@ def scan_fleet(log_root: str, now: Optional[float] = None
             last_event = {"event": last.get("event"),
                           "severity": last.get("severity"),
                           "round": last.get("round")}
+        # the forensics column (ISSUE 18 satellite): the run's last
+        # warn/error record from the ledger tail, plus whether a flight
+        # snapshot (obs/flight.py flight.json) sits next to the stream
+        last_incident = None
+        for rec in reversed(events):
+            if rec.get("severity") in ("warn", "error"):
+                last_incident = {"event": rec.get("event"),
+                                 "round": rec.get("round")}
+                break
+        flight_snapshot = os.path.exists(
+            os.path.join(base, "flight.json"))
         ledger_seq = (status or {}).get("ledger_seq")
         if ledger_seq is None and events:
             ledger_seq = events[-1].get("seq")
@@ -152,6 +163,8 @@ def scan_fleet(log_root: str, now: Optional[float] = None
             "val_acc": _last_metric(metrics, "Validation/Accuracy"),
             "ledger_seq": ledger_seq,
             "last_event": last_event,
+            "last_incident": last_incident,
+            "flight_snapshot": flight_snapshot,
             "warns": warn_err[0],
             "errors": warn_err[1],
             "health_incidents": (health or {}).get("incidents"),
@@ -181,6 +194,13 @@ def _cells(row: Dict[str, Any]) -> List[str]:
         ev += f"@{last['round']}"
     rnd = ("—" if row.get("round") is None
            else f"{row['round']}/{row.get('rounds') or '?'}")
+    # last warn/error + a "+fl" marker when a flight snapshot is present
+    inc = row.get("last_incident") or {}
+    incident = inc.get("event") or "—"
+    if inc.get("round") is not None:
+        incident += f"@{inc['round']}"
+    if row.get("flight_snapshot"):
+        incident = (f"{incident} +fl" if incident != "—" else "+fl")
     return [
         row["run"],
         str(row.get("phase", "?")),
@@ -189,6 +209,7 @@ def _cells(row: Dict[str, Any]) -> List[str]:
         "—" if row.get("val_acc") is None else f"{row['val_acc']:.3f}",
         "—" if row.get("ledger_seq") is None else str(row["ledger_seq"]),
         ev,
+        incident,
         f"{row.get('warns', 0)}/{row.get('errors', 0)}",
         _fmt_age(row),
     ]

@@ -83,7 +83,8 @@ REPLAY_DEDUPE_EVENTS = ("checkpoint/save", "health/defense_anomaly")
 # than its uninterrupted twin by construction. Comparisons that want the
 # interruption-invariant stream filter these (and, because the extra
 # records shift the numbering, also drop `seq`).
-PER_LIFE_PREFIXES = ("service/recover", "checkpoint/restore", "aot/")
+PER_LIFE_PREFIXES = ("service/recover", "checkpoint/restore", "aot/",
+                     "obs/trigger_")
 
 WALLCLOCK_FIELDS = ("t",)
 

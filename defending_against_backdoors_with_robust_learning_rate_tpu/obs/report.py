@@ -278,6 +278,12 @@ def render_markdown(doc: Dict[str, Any]) -> str:
                 f"| {_fmt(r['max'])} | {_fmt(r['limit'])} "
                 f"| {verdict}{note} |")
     add("")
+    if doc.get("explain"):
+        # cross-run forensics (obs/explain.py, --explain_baseline): the
+        # per-phase delta table against the named baseline run/artifact
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            explain as explain_mod)
+        add(explain_mod.render_markdown_section(doc["explain"]))
     return "\n".join(lines)
 
 
@@ -287,8 +293,11 @@ def render_markdown(doc: Dict[str, Any]) -> str:
 
 def generate(run_dir: str, trace_dir: Optional[str] = None,
              baseline_path: Optional[str] = None,
-             backend: str = "") -> Dict[str, Any]:
-    """Build the report document for one run dir (no files written)."""
+             backend: str = "",
+             explain_baseline: str = "") -> Dict[str, Any]:
+    """Build the report document for one run dir (no files written).
+    ``explain_baseline`` names a reference run dir or bench artifact to
+    diff this run against (obs/explain.py forensics section)."""
     jsonl = os.path.join(run_dir, "metrics.jsonl")
     if not os.path.exists(jsonl):
         raise FileNotFoundError(f"no metrics.jsonl under {run_dir!r} — "
@@ -325,6 +334,12 @@ def generate(run_dir: str, trace_dir: Optional[str] = None,
                        or os.path.join(repo_root(), BASELINE_NAME))
     doc["budget_results"] = check_budgets(bl, backend, metrics)
     doc["pass"] = all(r["pass"] for r in doc["budget_results"])
+    if explain_baseline:
+        # local import: obs/explain.py imports this module's readers
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            explain as explain_mod)
+        doc["explain"] = explain_mod.explain_paths(explain_baseline,
+                                                   run_dir)
     return doc
 
 
@@ -351,6 +366,10 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="",
                     help="output dir for report.md/report.json "
                          "(default: the run dir)")
+    ap.add_argument("--explain_baseline", default="",
+                    help="reference run dir or bench artifact to diff "
+                         "this run against (obs/explain.py: adds the "
+                         "Regression forensics section)")
     args = ap.parse_args(argv)
 
     baseline_path = args.baseline or os.path.join(repo_root(),
@@ -358,7 +377,8 @@ def main(argv=None) -> int:
     try:
         doc = generate(args.run_dir, trace_dir=args.trace_dir or None,
                        baseline_path=baseline_path,
-                       backend=args.backend)
+                       backend=args.backend,
+                       explain_baseline=args.explain_baseline)
     except (OSError, ValueError) as e:
         print(f"[report] ERROR: {e}", file=sys.stderr)
         return 2

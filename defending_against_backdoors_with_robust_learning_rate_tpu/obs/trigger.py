@@ -1,0 +1,199 @@
+"""Budgeted anomaly-triggered profiling: arm the round profiler when
+the flight window (or an incident hook) says something just got slow.
+
+Always-on profiling is too expensive for a resident fleet and manual
+profiling always arrives after the anomaly is gone. The middle path:
+
+- the service driver feeds every supervisor/health incident into
+  ``ProfileTrigger.note_incident``; between units, ``step`` also scans
+  the flight recorder's window for a span whose latest per-round
+  duration is a ``Z_THRESHOLD``-sigma outlier vs its own history
+  (``span_zscores``);
+- either signal arms a fresh ``obs/attribution.RoundProfiler`` for
+  ``DEFAULT_CAPTURE_ROUNDS`` steady rounds by slotting it into the
+  engine's ``prof`` seat — the dispatch loop then drives it exactly
+  like a user-requested ``--profile_rounds`` capture (which always
+  wins the seat: the trigger never preempts an explicit request);
+- when the window closes, ``attribute`` runs offline on the captured
+  trace and the device split lands as typed ``obs/trigger_*`` ledger
+  events (armed / capture / attribution) plus ``rlr_trigger_*``
+  exporter gauges — evidence attached to the run, no human in the
+  loop.
+
+Hard budget: ``MAX_CAPTURES`` windows per process life — an unstable
+run must not profile itself into the ground. Gated by
+``--trigger_profile on|off`` (default OFF: z-arming is inherently
+timing-dependent, and the attribution events would differ between
+byte-identity drill twins; the ``obs/trigger_*`` prefix is per-life in
+``obs/events`` for exactly that reason).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from . import attribution
+from . import events as obs_events
+
+DEFAULT_CAPTURE_ROUNDS = 6
+MAX_CAPTURES = 2
+Z_THRESHOLD = 4.0
+MIN_WINDOW = 8   # prior samples a span needs before z-scores mean anything
+
+
+def span_zscores(window: List[Dict[str, Any]],
+                 min_points: int = MIN_WINDOW) -> Dict[str, float]:
+    """Per-span z-score of the LATEST record's duration against that
+    span's history in the flight window. The sigma floor (5% of the
+    mean) keeps ultra-stable spans from flagging micro-jitter."""
+    if len(window) < min_points + 1:
+        return {}
+    latest = window[-1].get("spans") or {}
+    out: Dict[str, float] = {}
+    for name, dur in latest.items():
+        prior = [rec["spans"][name] for rec in window[:-1]
+                 if isinstance(rec.get("spans"), dict)
+                 and name in rec["spans"]]
+        if len(prior) < min_points:
+            continue
+        mean = sum(prior) / len(prior)
+        var = sum((p - mean) ** 2 for p in prior) / len(prior)
+        sigma = max(var ** 0.5, 0.05 * abs(mean), 1e-6)
+        out[name] = (dur - mean) / sigma
+    return out
+
+
+class ProfileTrigger:
+    """Anomaly-armed, budgeted wrapper around the engine's profiler
+    seat (module docstring). All methods are driver-thread only."""
+
+    def __init__(self, eng, run_dir: str, exporter=None,
+                 n_rounds: int = DEFAULT_CAPTURE_ROUNDS,
+                 max_captures: int = MAX_CAPTURES,
+                 z_threshold: float = Z_THRESHOLD,
+                 make_profiler=attribution.RoundProfiler):
+        self.eng = eng
+        self.run_dir = run_dir
+        self.exporter = exporter
+        self.n_rounds = n_rounds
+        self.max_captures = max_captures
+        self.z_threshold = z_threshold
+        self._make_profiler = make_profiler
+        self.captures = 0
+        self.prof = None                     # the window we armed, if any
+        self._pending: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------- signals
+
+    def note_incident(self, kind: str, rnd: Optional[int]) -> None:
+        """An incident hook fired (health rung, supervisor retry/give-up
+        and friends); arm at the next unit boundary."""
+        if self.captures < self.max_captures and self._pending is None:
+            self._pending = {"cause": kind, "round": rnd}
+
+    def _scan(self) -> Optional[Dict[str, Any]]:
+        flight = getattr(self.eng, "flight", None)
+        if flight is None:
+            return None
+        scores = span_zscores(flight.window())
+        if not scores:
+            return None
+        name, z = max(scores.items(), key=lambda kv: kv[1])
+        if z < self.z_threshold:
+            return None
+        return {"cause": f"zscore:{name}", "z": round(z, 2)}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def step(self, rnd: int) -> None:
+        """Per-unit driver hook: close a finished window, else consider
+        arming a new one."""
+        if self.prof is not None:
+            if self.prof.done:
+                self._finish(rnd)
+            return
+        if self.captures >= self.max_captures:
+            return
+        trip = self._pending or self._scan()
+        self._pending = None
+        if trip is not None:
+            self._arm(rnd, trip)
+
+    def _arm(self, rnd: int, trip: Dict[str, Any]) -> None:
+        if self.eng.prof is not None:
+            return   # an explicit --profile_rounds capture owns the seat
+        trace_dir = os.path.join(
+            self.run_dir, "trigger_profile", f"cap{self.captures}")
+        try:
+            prof = self._make_profiler(self.n_rounds, trace_dir)
+        except Exception:
+            return   # profiler backends may be absent; never down the run
+        self.prof = prof
+        self.eng.prof = prof       # the dispatch loop now drives it
+        obs_events.emit("obs/trigger_armed", severity="warn", round=rnd,
+                        cause=trip.get("cause"),
+                        z=trip.get("z"), rounds=self.n_rounds,
+                        capture=self.captures)
+        flight = getattr(self.eng, "flight", None)
+        if flight is not None:
+            # the window that tripped the trigger IS the evidence
+            flight.snapshot(f"trigger_armed:{trip.get('cause')}", rnd)
+
+    def _finish(self, rnd: int) -> None:
+        prof, self.prof = self.prof, None
+        if self.eng.prof is prof:
+            self.eng.prof = None
+        self.captures += 1
+        try:
+            attr = prof.result()
+        except Exception:
+            attr = None
+        obs_events.emit("obs/trigger_capture", round=rnd,
+                        capture=self.captures - 1,
+                        rounds=prof.captured,
+                        attributed=bool(attr and attr.get("device_present")))
+        if attr and attr.get("device_present"):
+            per = attr.get("per_round", {})
+            obs_events.emit(
+                "obs/trigger_attribution", round=rnd,
+                capture=self.captures - 1,
+                compute_ms=per.get("compute_ms"),
+                collective_ms=per.get("collective_ms"),
+                gap_ms=per.get("gap_ms"),
+                collective_frac=attr.get("collective_frac"))
+            if self.exporter is not None:
+                ex = self.exporter
+                ex.set("trigger_compute_ms", per.get("compute_ms", 0.0),
+                       help_text="Per-round device compute ms from the "
+                                 "last triggered capture")
+                ex.set("trigger_collective_frac",
+                       attr.get("collective_frac", 0.0),
+                       help_text="Collective share of device time from "
+                                 "the last triggered capture")
+                ex.set("trigger_gap_ms", per.get("gap_ms", 0.0),
+                       help_text="Per-round device idle-gap ms from the "
+                                 "last triggered capture")
+        if self.exporter is not None:
+            self.exporter.set("trigger_captures_total", self.captures,
+                              mtype="counter",
+                              help_text="Anomaly-triggered profile "
+                                        "captures completed this run")
+            self.exporter.flush()
+
+    def finalize(self, rnd: int) -> None:
+        """End-of-run hook: a window still open at exit is harvested if
+        it captured anything (short runs arm near the end), else torn
+        down without burning the budget's evidence trail."""
+        if self.prof is None:
+            return
+        try:
+            self.prof.close(getattr(self.eng, "params", None))
+        except Exception:
+            pass
+        if self.prof.captured > 0:
+            self._finish(rnd)
+        else:
+            if self.eng.prof is self.prof:
+                self.eng.prof = None
+            self.prof = None

@@ -52,7 +52,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     monitor as health_monitor)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     attribution as obs_attribution, events as obs_events,
-    export as obs_export)
+    export as obs_export, trigger as obs_trigger)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service import (
     chaos as chaos_mod, churn as churn_mod)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service.supervisor import (
@@ -317,17 +317,51 @@ def _serve(cfg: Config, writer, max_rounds, _adapt, _adapt_reentry,
     sup = Supervisor(retries=cfg.service_retries,
                      backoff_s=cfg.service_backoff_s,
                      deadline_s=cfg.service_deadline_s, hb=eng.hb)
+    # forensics plane (ISSUE 18): the engine's flight recorder snapshots
+    # its ring on every incident, and (opt-in) the anomaly trigger arms
+    # a bounded profiler capture. Wired through hooks so the evidence
+    # lands even when the event ledger is off.
+    flight = getattr(eng, "flight", None)
+    trigger = None
+    if lead and cfg.trigger_profile == "on" and cfg.profile_rounds <= 0:
+        trigger = obs_trigger.ProfileTrigger(
+            eng, getattr(writer, "dir", None) or cfg.log_dir,
+            exporter=exporter)
+        print(f"[service] anomaly-triggered profiling armed: span "
+              f"z>={obs_trigger.Z_THRESHOLD} or any incident opens a "
+              f"{obs_trigger.DEFAULT_CAPTURE_ROUNDS}-round capture "
+              f"(max {obs_trigger.MAX_CAPTURES}/run)")
+    elif lead and cfg.trigger_profile == "on":
+        print("[service] --trigger_profile ignored: an explicit "
+              "--profile_rounds capture owns the profiler seat")
+
+    def _on_incident(kind, rnd):
+        if flight is not None:
+            flight.snapshot(kind, rnd)
+        if trigger is not None:
+            trigger.note_incident(kind, rnd)
+
+    sup.on_incident = _on_incident
+    if ladder is not None:
+        ladder.on_rung = lambda rung, r: _on_incident(f"health/{rung}", r)
     if ledger is not None:
         # heartbeat upgrade (ISSUE 15 satellite): every emitted record
         # mirrors its seq + identity into status.json, so watchers can
         # detect a wedged ledger without tailing events.jsonl. Rides the
         # heartbeat's normal rate limit — event churn must not become
-        # fsync churn.
+        # fsync churn. Warn/error records double as the flight
+        # recorder's incident feed (chaos actions, degradations — every
+        # incident the hooks above don't already cover).
         def _hb_event(rec, hb=eng.hb):
             hb.update(ledger_seq=rec["seq"],
                       last_event={"event": rec["event"],
                                   "severity": rec["severity"],
                                   "round": rec["round"]})
+            if rec["severity"] != "info" and \
+                    not rec["event"].startswith("obs/trigger_"):
+                # the trigger's own armed event is warn-severity; feeding
+                # it back would re-arm the trigger on itself
+                _on_incident(rec["event"], rec["round"])
         ledger.on_emit = _hb_event
     if _phases:
         # in-process re-entry (health ladder / adaptation): the phase
@@ -509,6 +543,10 @@ def _serve(cfg: Config, writer, max_rounds, _adapt, _adapt_reentry,
                         adapt_to = (new_thr, rnd)
                         break
             eng.post_unit()
+            if trigger is not None:
+                # after post_unit, so the flight window the z-scan reads
+                # already includes this unit's record
+                trigger.step(rnd)
         if eng.drain is not None:
             eng.hb.update(phase="drain", force=True)
             eng.drain.flush()
@@ -623,6 +661,10 @@ def _serve(cfg: Config, writer, max_rounds, _adapt, _adapt_reentry,
         svc["adaptations"] = [
             {"round": r, "from": f, "to": t} for r, f, t in adapt.moves]
         return sub
+    if trigger is not None:
+        # a capture window still open at exit: harvest what it caught
+        # (eng.close() already stopped the trace on the engine's seat)
+        trigger.finalize(eng.rnd)
     eng.hb.update(force=True, evals_skipped=evals_skipped,
                   **sup.heartbeat_fields())
     if exporter is not None:
@@ -770,6 +812,18 @@ def _update_exporter(exporter, eng, sup: Supervisor, ladder,
         exporter.set("ledger_seq", ledger.seq,
                      help_text="event-ledger sequence number "
                                "(obs/events.py)")
+    cfg = eng.cfg
+    if cfg.traffic_enabled and cfg.num_agents <= CENSUS_MAX_POPULATION:
+        # diurnal-traffic census (data/traffic.py, ISSUE 17 follow-up):
+        # computed per boundary for the console print but never exported
+        # until now. Host-side O(population) draw, same bound as the
+        # churn census.
+        from defending_against_backdoors_with_robust_learning_rate_tpu.data import (
+            traffic as traffic_mod)
+        exporter.set("traffic_present_clients",
+                     traffic_mod.census(cfg, rnd),
+                     help_text="clients traffic-present this round "
+                               "(data/traffic.py census)")
     for key, value in obs_attribution.memory_watermarks().items():
         exporter.set(key, value,
                      help_text="device allocator watermark (bytes)")

@@ -100,6 +100,21 @@ class Supervisor:
             "retries": 0, "transient": 0, "wedged": 0, "poisoned": 0,
             "gave_up": 0, "slow_units": 0}
         self.phases_seen: List[str] = []
+        # optional incident hook, on_incident(kind, unit_round): the
+        # driver wires the flight recorder's snapshot + the profile
+        # trigger here so retries/give-ups/slow units leave evidence
+        # even when the event ledger is off
+        self.on_incident: Optional[Callable[[str, Optional[int]],
+                                            None]] = None
+
+    def _incident(self, kind: str, unit) -> None:
+        if self.on_incident is None:
+            return
+        try:
+            self.on_incident(kind,
+                             unit if isinstance(unit, int) else None)
+        except Exception:
+            pass  # observability must never take down the run
 
     # ------------------------------------------------------------- helpers
 
@@ -148,6 +163,7 @@ class Supervisor:
                                     else None,
                                     kind=kind, classification=cls,
                                     attempts=attempt + 1)
+                    self._incident(f"supervisor/give_up:{kind}", unit)
                     raise UnitFailure(kind, unit, cls, attempt + 1, e) \
                         from e
                 delay = self.backoff(attempt)
@@ -164,6 +180,7 @@ class Supervisor:
                                 else None,
                                 kind=kind, classification=cls,
                                 attempt=attempt, backoff_s=delay)
+                self._incident(f"supervisor/retry:{kind}", unit)
                 self.phase("retry", retry_kind=kind)
                 self.phase("backoff", retry_kind=kind)
                 self._sleep(delay)
@@ -180,6 +197,7 @@ class Supervisor:
                 obs_events.emit("supervisor/slow", severity="warn",
                                 round=unit if isinstance(unit, int)
                                 else None, kind=kind)
+                self._incident(f"supervisor/slow:{kind}", unit)
                 self.phase("slow", slow_kind=kind)
             return out
 

@@ -47,7 +47,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     monitor as health_monitor, sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
-    events as obs_events, telemetry as obs_telemetry)
+    events as obs_events, flight as obs_flight,
+    telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -944,6 +945,22 @@ class RoundEngine:
         if self._whole_run_trace:
             jax.profiler.start_trace(cfg.profile_dir)
 
+        # incident flight recorder (obs/flight.py): a bounded per-round
+        # ring + crash-exact flight.jsonl next to metrics.jsonl, lead
+        # process only. Span durations ride the tracer's completion
+        # hook, chained after the heartbeat's — no extra timing calls
+        # on the hot path.
+        self.flight = None
+        if cfg.flight == "on" and lead:
+            flight_dir = getattr(writer, "dir", None) or cfg.log_dir
+            flight_run = run_name(cfg)
+            self.flight = obs_flight.FlightRecorder(
+                os.path.join(flight_dir, obs_flight.STREAM_NAME),
+                run=flight_run, corr=obs_events.corr_id(flight_run),
+                slot=f"p{jax.process_index()}"
+                     + (f"-E{cfg.tenants}" if cfg.tenants > 0 else ""))
+            tracer.chain_on_end(self.flight.observe_span)
+
         # --- async metrics pipeline: per-round/eval scalars stay on device
         # and drain through a background thread's batched device_get, so
         # the round loop never blocks on a host sync (~24% of round time on
@@ -987,6 +1004,7 @@ class RoundEngine:
         self._chained_fn, self._host_chained_fn = chained_fn, host_chained_fn
         self._eval_val_fn, self._eval_pval_fn = eval_val_fn, eval_pval_fn
         self._last_info = {}
+        self._last_unit_rounds = 1
         self._want_diag = False
         self._prev_params = None
         self.t_loop = time.perf_counter()
@@ -1046,6 +1064,9 @@ class RoundEngine:
         host, dispatches unchained)."""
         cfg, tracer = self.cfg, self.tracer
         self.hb.update(phase="train", round=unit[-1])
+        if self.flight is not None:
+            self.flight.begin_unit()
+        self._last_unit_rounds = len(unit)
         if self.prof is not None and not self.first_unit:
             # steady state: every hot-path program compiled during the
             # first unit, so the window never captures XLA working
@@ -1175,8 +1196,10 @@ class RoundEngine:
         # HBM watermarks ride the heartbeat so the session stall detectors
         # see memory pressure, not just phase ({} on backends without
         # allocator stats)
-        self.hb.update(phase="eval", round=rnd,
-                       **obs_attribution.memory_watermarks())
+        mem = obs_attribution.memory_watermarks()
+        self.hb.update(phase="eval", round=rnd, **mem)
+        if self.flight is not None and mem:
+            self.flight.note(**mem)
         # divergence aborts only under --debug_nan (sync mode); otherwise
         # the finite check rides the drain and warns, and the run keeps
         # recording its (NaN) metrics
@@ -1288,6 +1311,10 @@ class RoundEngine:
             # the staleness mix it accumulated since the last commit
             writer.scalar("Async/Buffer_Fill",
                           float(vals["async_fill"]), ernd)
+            if self.flight is not None:
+                # flight-record the fill on the same (possibly drain-)
+                # thread that materialized it — note() is lock-guarded
+                self.flight.note(buffer_fill=float(vals["async_fill"]))
             writer.scalar("Async/Committed",
                           float(vals["async_committed"]), ernd)
             for i, c in enumerate(vals["async_stale_hist"]):
@@ -1394,11 +1421,16 @@ class RoundEngine:
 
     def post_unit(self) -> None:
         """End-of-unit bookkeeping: flip the compile flag after the first
-        unit (from here a silent heartbeat means a stall, not XLA working)
-        and flush the writer in sync mode."""
+        unit (from here a silent heartbeat means a stall, not XLA working),
+        close the flight record and flush the writer in sync mode."""
         if self.first_unit:
             self.first_unit = False
             self.hb.update(compile_in_flight=False, force=True)
+        if self.flight is not None:
+            self.flight.end_unit(
+                self.rnd, unit_rounds=self._last_unit_rounds,
+                drain_depth=(self.drain.pending
+                             if self.drain is not None else None))
         if self.drain is None:
             self.writer.flush()
 
@@ -1417,6 +1449,10 @@ class RoundEngine:
         if self.prof is not None:
             # a run shorter than the budget still flushes its window
             self.prof.close(self.params)
+        if self.flight is not None:
+            # stream handle only — the ring stays live so the driver can
+            # still snapshot a post-teardown incident (recovery re-entry)
+            self.flight.close()
 
     def finalize(self) -> Dict:
         """Post-loop summary: throughput, attribution, memory watermarks,
@@ -1491,6 +1527,10 @@ class RoundEngine:
                     summary["trace_path"] = trace_path
                     print(f"[spans] {trace_path} "
                           f"(load in https://ui.perfetto.dev)")
+        if self.flight is not None:
+            # the clean-exit snapshot: flight.json always reflects the
+            # run's final window, incident or not
+            self.flight.snapshot("clean_exit", self.rnd)
         writer.close()
         self.hb.close("done")
         return summary

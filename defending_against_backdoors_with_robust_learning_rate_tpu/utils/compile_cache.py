@@ -92,6 +92,9 @@ EXCLUDED_FIELDS = frozenset({
     "spans", "heartbeat", "status_file",
     # fleet observability (ISSUE 15): ledger + exporter are host-side IO
     "events", "metrics_port", "metrics_textfile",
+    # forensics (ISSUE 18): flight recorder + profile trigger are
+    # host-side IO around the dispatch loop — neither shapes a program
+    "flight", "trigger_profile",
     # fingerprint-drift fixes (ISSUE 4 audit): runtime-only fields that
     # used to split identical programs across cache keys. `platform`
     # (backend is fingerprinted directly), the multihost rendezvous

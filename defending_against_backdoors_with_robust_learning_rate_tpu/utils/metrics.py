@@ -175,6 +175,14 @@ class MetricsDrain:
         with self._lock:
             return self._dead
 
+    @property
+    def pending(self) -> int:
+        """Queued-but-undrained callback count — the backpressure gauge
+        the flight recorder samples per round (a growing depth is the
+        earliest sign a boundary is outrunning the host sync)."""
+        with self._lock:
+            return self._pending
+
     def _raise_pending_locked(self) -> None:
         """Deliver the drain thread's error exactly once (caller holds the
         lock)."""

@@ -27,7 +27,46 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (  # noqa: E402
-    trajectory)
+    explain as explain_mod, trajectory)
+
+
+def _auto_explain(traj, results, traj_path) -> None:
+    """On a gate FAIL, diff each failing point against its group's best
+    earlier point when both source artifacts are still on disk — the
+    FAIL then names the regressed phase, not just the ratio."""
+    failed = {r["label"] for r in results if not r["pass"]}
+    base_dir = os.path.dirname(os.path.abspath(traj_path))
+    tol = float(traj.get("tolerance", trajectory.DEFAULT_TOLERANCE))
+    best = {}   # group -> (value, label) of the best EARLIER ok point
+    for point in traj["series"]:
+        if not point.get("ok"):
+            continue
+        value = trajectory.point_value(point)
+        group, label = point["group"], point["label"]
+        prev = best.get(group)
+        if label in failed and prev is not None:
+            prev_point = next(p for p in traj["series"]
+                              if p["label"] == prev[1])
+            paths = [os.path.join(base_dir, p.get("source") or "")
+                     for p in (prev_point, point)]
+            if all(p.get("source") for p in (prev_point, point)) \
+                    and all(os.path.exists(pth) for pth in paths):
+                try:
+                    doc = explain_mod.explain_paths(paths[0], paths[1],
+                                                    tolerance=tol)
+                except explain_mod.MalformedInput as e:
+                    print(f"[explain] skipped ({e})", file=sys.stderr)
+                else:
+                    for line in explain_mod.render_text(doc):
+                        print(line)
+            else:
+                print(f"[explain] hint: source artifacts for "
+                      f"{prev[1]!r} / {label!r} not on disk — run "
+                      f"scripts/bench_trajectory.py --explain <base> "
+                      f"<cand> on the artifact pair to localize the "
+                      f"regression")
+        if prev is None or value > prev[0]:
+            best[group] = (value, label)
 
 
 def main(argv=None) -> int:
@@ -47,7 +86,29 @@ def main(argv=None) -> int:
     ap.add_argument("--tolerance", type=float, default=None,
                     help="override the pinned regression tolerance "
                          "(fraction; persisted with --write)")
+    ap.add_argument("--explain", nargs=2, metavar=("BASE", "CAND"),
+                    default=None,
+                    help="regression forensics (obs/explain.py): diff "
+                         "two run dirs or bench artifacts into a "
+                         "per-span/per-phase delta table and name the "
+                         "regressed phase; exit 1 when the candidate "
+                         "regressed past tolerance, 2 on malformed "
+                         "input")
     args = ap.parse_args(argv)
+
+    if args.explain is not None:
+        try:
+            doc = explain_mod.explain_paths(
+                args.explain[0], args.explain[1],
+                tolerance=(args.tolerance
+                           if args.tolerance is not None
+                           else trajectory.DEFAULT_TOLERANCE))
+        except explain_mod.MalformedInput as e:
+            print(f"[explain] ERROR: {e}", file=sys.stderr)
+            return 2
+        for line in explain_mod.render_text(doc):
+            print(line)
+        return 1 if doc["verdict"]["regressed"] else 0
 
     try:
         traj = trajectory.load(args.trajectory)
@@ -91,6 +152,10 @@ def main(argv=None) -> int:
     print(f"[trajectory] {sum(r['pass'] for r in judged)}/{len(judged)} "
           f"judged point(s) pass (tolerance "
           f"{traj.get('tolerance', trajectory.DEFAULT_TOLERANCE)})")
+    if not ok:
+        # a FAIL should localize itself: diff the failing point against
+        # its group's best earlier artifact when both are on disk
+        _auto_explain(traj, results, args.trajectory)
     return 0 if ok else 1
 
 

@@ -33,7 +33,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     monitor as health_monitor)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     console as obs_console, events as obs_events, export as obs_export,
-    trajectory as obs_trajectory)
+    flight as obs_flight, trajectory as obs_trajectory)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs.constants import (
     NON_TIMING_PREFIXES)
 from defending_against_backdoors_with_robust_learning_rate_tpu.service.driver import (
@@ -208,6 +208,9 @@ def _fixture_fleet(root):
         if i == 1:
             led.emit("supervisor/give_up", severity="error", round=3,
                      kind="dispatch")
+            with open(os.path.join(run_dir, "flight.json"), "w") as f:
+                json.dump({"v": 1, "reason": "supervisor/give_up",
+                           "round": 3, "window": []}, f)
         led.close()
         if i < 2:
             with open(os.path.join(log_dir, "status.json"), "w") as f:
@@ -229,8 +232,15 @@ def test_console_renders_fixture_fleet(tmp_path):
     assert by["run_a"]["val_acc"] == pytest.approx(0.9)
     assert by["run_a"]["ledger_seq"] == 1
     assert by["run_c"]["stale"]          # no heartbeat at all
+    # ISSUE 18 satellite: the INCIDENT column — last warn/error from the
+    # ledger tail, "+fl" when a flight snapshot sits next to the stream
+    assert by["run_b"]["last_incident"]["event"] == "supervisor/give_up"
+    assert by["run_b"]["flight_snapshot"]
+    assert by["run_a"]["last_incident"] is None
+    assert not by["run_a"]["flight_snapshot"]
     text = obs_console.render_table(rows)
-    for name in ("run_a", "run_b", "run_c", "RUN", "LAST EVENT"):
+    for name in ("run_a", "run_b", "run_c", "RUN", "LAST EVENT",
+                 "INCIDENT", "supervisor/give_up@3 +fl"):
         assert name in text
     # --html writes a standalone table
     rc = obs_console.main([str(tmp_path), "--html",
@@ -335,6 +345,8 @@ def fleet(tmp_path_factory, svc_cache):
     serve(out["b"].replace(service_rounds=4))
     with open(_events(out["b"]), "ab") as f:
         f.write(b'{"seq": 99, "event": "torn')   # kill mid-write
+    with open(_flight(out["b"]), "ab") as f:
+        f.write(b'{"seq": 99, "round')           # ...torn flight too
     serve(out["b"])
     # events off: nothing armed, metrics stream untouched
     out["c"] = _cfg(root, svc_cache, "c", service_rounds=8,
@@ -345,6 +357,11 @@ def fleet(tmp_path_factory, svc_cache):
 
 def _events(cfg):
     return os.path.join(cfg.log_dir, run_name(cfg), "events.jsonl")
+
+
+def _flight(cfg):
+    return os.path.join(cfg.log_dir, run_name(cfg),
+                        obs_flight.STREAM_NAME)
 
 
 def _metric_lines(cfg):
@@ -442,6 +459,59 @@ def test_events_off_arms_nothing_and_metrics_identical(fleet):
         _metric_lines(fleet["a"]))
 
 
+def test_flight_stream_deterministic_across_drills(fleet):
+    """ISSUE 18: two independent nan drills leave flight streams whose
+    non-timing projection is byte-identical — same rounds streamed, same
+    seq numbering, same correlation id and slot."""
+    d1 = obs_flight.read_flight(_flight(fleet["d1"]))
+    d2 = obs_flight.read_flight(_flight(fleet["d2"]))
+    assert d1, "flight recorder is default-on and must stream"
+    assert obs_flight.strip_timing(d1) == obs_flight.strip_timing(d2)
+    assert [r["seq"] for r in d1] == list(range(len(d1)))
+    assert len({r["corr"] for r in d1}) == 1
+    assert d1[0]["corr"] == obs_events.corr_id(run_name(fleet["d1"]))
+    # the timing tail is populated, not dead weight
+    assert any(r["spans"] for r in d1)
+    assert any(r.get("drain_depth") is not None for r in d1)
+
+
+def test_flight_snapshot_written_on_incident(fleet):
+    """Acceptance: a chaos health incident produces flight.json — the
+    nan drill snapshots on every rung/incident and again on clean exit,
+    and the LAST snapshot still carries the incident window."""
+    snap_path = os.path.join(os.path.dirname(_flight(fleet["d1"])),
+                             obs_flight.SNAPSHOT_NAME)
+    doc = obs_flight.read_snapshot(snap_path)
+    assert doc is not None and doc["reason"]
+    assert doc["corr"] == obs_events.corr_id(run_name(fleet["d1"]))
+    assert doc["window"] and doc["window_rounds"] == len(doc["window"])
+
+
+def test_flight_splice_across_interrupted_resume(fleet):
+    """ISSUE 18 crash-exactness: the clean-stop-and-continue run's
+    flight stream (with a torn tail injected at the kill point) equals
+    the uninterrupted twin's under strip_timing — the resume truncated
+    the tear, continued the seq numbering and deduped replays."""
+    a = obs_flight.read_flight(_flight(fleet["a"]))
+    b = obs_flight.read_flight(_flight(fleet["b"]))
+    assert a and obs_flight.strip_timing(b) == obs_flight.strip_timing(a)
+    assert [r["seq"] for r in b] == list(range(len(b)))
+    rounds = [r["round"] for r in b]
+    assert rounds == sorted(set(rounds))   # replays streamed nothing
+
+
+def test_flight_never_touches_metrics_or_events(fleet):
+    """Default-on must not move existing byte-identity drills: the
+    flight recorder writes ONLY its own files (the a/c metrics equality
+    in test_events_off_arms_nothing_and_metrics_identical already pins
+    the metrics bytes; here: no flight rows leak into either stream)."""
+    joined = json.dumps(obs_events.read_events(_events(fleet["a"])))
+    assert "flight" not in joined
+    assert "flight" not in json.dumps(_metric_lines(fleet["a"]))
+    # --events off still flies the recorder (independent planes)
+    assert os.path.exists(_flight(fleet["c"]))
+
+
 def test_console_on_real_fleet(fleet):
     """The console renders the module's real runs (ledgers + heartbeats
     from actual serves, not fixtures)."""
@@ -496,3 +566,13 @@ def test_kill_recover_ledger_byte_identical_to_unkilled_twin(
     assert twin and obs_events.strip_wallclock(drill) == \
         obs_events.strip_wallclock(twin)
     assert len({r["corr"] for r in drill}) == 1
+    # ISSUE 18: the flight stream shares the ledger's crash-exactness —
+    # the SIGKILLed run's flight.jsonl is byte-identical (non-timing
+    # projection) to its unkilled twin's, and the kill left a snapshot
+    fl_twin = obs_flight.read_flight(_flight(cfg_t))
+    fl_drill = obs_flight.read_flight(_flight(cfg_d))
+    assert fl_twin and obs_flight.strip_timing(fl_drill) == \
+        obs_flight.strip_timing(fl_twin)
+    assert obs_flight.read_snapshot(
+        os.path.join(os.path.dirname(_flight(cfg_d)),
+                     obs_flight.SNAPSHOT_NAME)) is not None
