@@ -1,12 +1,21 @@
 """CLI for the static-analysis passes.
 
     python -m defending_against_backdoors_with_robust_learning_rate_tpu.analysis
-        [--rules ast,audit,jaxpr] [--sharded] [--compiled]
+        [--rules ast,audit,jaxpr,thread,coverage] [--sharded] [--compiled]
         [--write-baseline] [--no-baseline-check] [--json]
-        [--force-host-devices N] [--platform cpu]
+        [--census-json PATH] [--force-host-devices N] [--platform cpu]
 
-Exit codes: 0 clean, 1 findings, 2 internal error (a pass crashed — that
-is a bug in the pass or an unbuildable program family, not a lint hit).
+Exit codes are staged so CI can tell WHICH gate tripped:
+
+    0  clean
+    1  findings from the legacy passes (ast / audit / jaxpr)
+    2  internal error (a pass crashed — that is a bug in the pass or an
+       unbuildable program family, not a lint hit)
+    3  findings from the thread pass only (host-concurrency races)
+    4  findings from the coverage pass only (program-family lattice gaps)
+
+When several tiers trip at once the lowest-numbered finding code wins
+(legacy before thread before coverage).
 """
 
 from __future__ import annotations
@@ -15,6 +24,9 @@ import argparse
 import json
 import os
 import sys
+
+# census/exit-code staging order; legacy passes outrank the newer tiers
+PASS_ORDER = ("ast", "audit", "jaxpr", "thread", "coverage")
 
 
 def repo_root() -> str:
@@ -26,9 +38,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="analysis",
         description="JAX-aware static analysis: AST rules, jaxpr "
-                    "contracts, fingerprint audit")
-    ap.add_argument("--rules", default="ast,audit,jaxpr",
-                    help="comma subset of ast|audit|jaxpr")
+                    "contracts, fingerprint audit, host-concurrency "
+                    "races, program-family coverage")
+    ap.add_argument("--rules", default=",".join(PASS_ORDER),
+                    help="comma subset of ast|audit|jaxpr|thread|coverage")
     ap.add_argument("--sharded", action="store_true",
                     help="also check the shard_map program families "
                          "(needs >1 devices dividing agents_per_round)")
@@ -50,6 +63,10 @@ def main(argv=None) -> int:
                          "analysis_baseline.json")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--census-json", default="",
+                    help="also write {pass: finding_count} + the staged "
+                         "exit code to this path (the CI job summary "
+                         "reads it)")
     ap.add_argument("--platform", default="",
                     help="force a jax platform for the jaxpr pass "
                          "(cpu|tpu); empty = default")
@@ -58,7 +75,7 @@ def main(argv=None) -> int:
                          "before jax initializes; use 8 for the CI mesh)")
     args = ap.parse_args(argv)
     rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-    unknown = rules - {"ast", "audit", "jaxpr"}
+    unknown = rules - set(PASS_ORDER)
     if unknown:
         ap.error(f"unknown rules {sorted(unknown)}")
 
@@ -70,17 +87,21 @@ def main(argv=None) -> int:
                 f"{args.force_host_devices}").strip()
 
     root = repo_root()
-    findings = []
+    by_pass = {}
     baseline = None
     try:
         if "ast" in rules:
             from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
                 ast_rules)
-            findings.extend(ast_rules.scan_repo(root))
+            by_pass["ast"] = list(ast_rules.scan_repo(root))
         if "audit" in rules:
             from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
                 fingerprint_audit)
-            findings.extend(fingerprint_audit.audit(root))
+            by_pass["audit"] = list(fingerprint_audit.audit(root))
+        if "thread" in rules:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+                thread_rules)
+            by_pass["thread"] = list(thread_rules.scan_repo(root))
         if "jaxpr" in rules:
             if args.platform:
                 import jax
@@ -92,14 +113,19 @@ def main(argv=None) -> int:
             jfind, baseline = jaxpr_lint.run(sharded=args.sharded,
                                              compiled=args.compiled,
                                              topologies=topologies)
-            findings.extend(jfind)
+            by_pass["jaxpr"] = list(jfind)
             if args.write_baseline:
-                path = jaxpr_lint.write_baseline(root, baseline)
+                path = jaxpr_lint.write_baseline(root, baseline,
+                                                 prune=True)
                 print(f"[analysis] baseline written: {path}",
                       file=sys.stderr)
             elif not args.no_baseline_check:
-                findings.extend(
+                by_pass["jaxpr"].extend(
                     jaxpr_lint.compare_baseline(root, baseline))
+        if "coverage" in rules:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+                coverage)
+            by_pass["coverage"] = list(coverage.scan_repo(root))
     except Exception as e:  # a crashed pass is exit 2, not a finding
         print(f"[analysis] INTERNAL ERROR: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -107,17 +133,32 @@ def main(argv=None) -> int:
         traceback.print_exc()
         return 2
 
+    findings = [f for p in PASS_ORDER for f in by_pass.get(p, ())]
+    census = {p: len(by_pass[p]) for p in PASS_ORDER if p in by_pass}
+    if by_pass.get("ast") or by_pass.get("audit") or by_pass.get("jaxpr"):
+        code = 1
+    elif by_pass.get("thread"):
+        code = 3
+    elif by_pass.get("coverage"):
+        code = 4
+    else:
+        code = 0
+
     if args.as_json:
         print(json.dumps([vars(f) for f in findings], indent=1))
     else:
         for f in findings:
             print(f)
-        ran = ",".join(sorted(rules))
-        print(f"[analysis] {len(findings)} finding(s) "
+        ran = ",".join(p for p in PASS_ORDER if p in rules)
+        per = " ".join(f"{p}={n}" for p, n in census.items())
+        print(f"[analysis] {len(findings)} finding(s) [{per}] "
               f"({ran}{' +sharded' if args.sharded else ''}"
               f"{' +compiled' if args.compiled else ''})",
               file=sys.stderr)
-    return 1 if findings else 0
+    if args.census_json:
+        with open(args.census_json, "w", encoding="utf-8") as f:
+            json.dump({"census": census, "exit_code": code}, f, indent=1)
+    return code
 
 
 if __name__ == "__main__":

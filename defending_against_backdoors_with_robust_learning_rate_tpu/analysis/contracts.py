@@ -117,6 +117,57 @@ ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
                            "program without entering the AOT fingerprint "
                            "— never set them outside profiling",
     },
+    (f"{PKG}/data/prefetch.py", "_worker"): {
+        "cross-thread-state": "_err is written exactly once, and the "
+                              "sentinel put() that follows it publishes "
+                              "the write — Queue's internal lock gives "
+                              "the consuming get() the happens-before "
+                              "edge before _raise_if_failed reads it",
+    },
+    (f"{PKG}/data/bank.py", "_write_range"): {
+        "racy-file-write": "every shard + digest sidecar lands inside "
+                           "the build's PRIVATE tmp directory (one per "
+                           "worker range, non-overlapping shard ids); "
+                           "the parent publishes the finished tree with "
+                           "a single atomic os.replace after all "
+                           "workers join",
+    },
+    (f"{PKG}/utils/metrics.py", "_stop_and_join"): {
+        "cross-thread-state": "joining while holding _cond would "
+                              "deadlock the worker's final drain; only "
+                              "the owning submitter thread calls close/"
+                              "_stop_and_join, and the worker never "
+                              "touches _thread — the join() itself is "
+                              "the synchronization edge",
+    },
+    (f"{PKG}/obs/export.py", "close"): {
+        "cross-thread-state": "teardown runs on the owning driver "
+                              "thread; holding _lock across shutdown()/"
+                              "join() could deadlock a mid-scrape "
+                              "render, and the scrape thread only READS "
+                              "via render() — it never writes _server/"
+                              "_thread; shutdown()+join() is the "
+                              "synchronization edge",
+    },
+    (f"{PKG}/service/tenancy.py", "load_slot"): {
+        "cross-thread-state": "slot replacement runs only in the "
+                              "scheduler harness, which constructs the "
+                              "pack with evict_on_anomaly=True and "
+                              "therefore drain=None (tenancy.py) — no "
+                              "drain thread exists to race the slots "
+                              "write; the gather executor only runs "
+                              "inside step(), never concurrently with "
+                              "load_slot",
+    },
+    (f"{PKG}/service/tenancy.py", "_emit_all"): {
+        "cross-thread-state": "the steady-state counters are folded "
+                              "only inside _emit_all, which runs "
+                              "serialized on the single MetricsDrain "
+                              "worker (submits are queued); steady_rps "
+                              "reads them only after close() has "
+                              "flushed and joined the drain — the join "
+                              "is the happens-before edge",
+    },
 }
 
 # Cross-module donated-buffer callees the donate-reuse rule tracks: callee
@@ -765,6 +816,61 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         sharded=True, cfg_overrides={**hlth, "tenants": 2},
         collective_budget={**zero, "psum": 2 * n_leaves + 2},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
+    # lattice cross-terms the coverage pass (analysis/coverage.py)
+    # surfaced as reachable-but-unpinned: the suffix algebra composes
+    # (_async x _mb x _mt, each mechanism individually pinned above),
+    # and composition must not change any layout's communication plan —
+    # avg+RLR stays within 2L+2 psums on every sharded cross-term.
+    # Measured at 1/8/16-way like every sharded family.
+    specs["sharded_rlr_avg_async_mb"] = CheckSpec(
+        name="sharded_rlr_avg_async_mb", family="round_sharded_async_mb",
+        sharded=True,
+        cfg_overrides={**buf, "train_layout": "megabatch"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_mb_mt"] = CheckSpec(
+        name="sharded_rlr_avg_mb_mt", family="round_sharded_mb_mt",
+        sharded=True,
+        cfg_overrides={"train_layout": "megabatch", "tenants": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_async_mb_mt"] = CheckSpec(
+        name="sharded_rlr_avg_async_mb_mt",
+        family="round_sharded_async_mb_mt", sharded=True,
+        cfg_overrides={**buf, "train_layout": "megabatch", "tenants": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_async_mb"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_async_mb",
+        family="round_sharded_cohort_async_mb", sharded=True,
+        cfg_overrides={**buf, "cohort_sampled": "on",
+                       "train_layout": "megabatch"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_host_rlr_avg_mb"] = CheckSpec(
+        name="sharded_host_rlr_avg_mb", family="round_sharded_host_mb",
+        sharded=True, host_mode=True,
+        cfg_overrides={"train_layout": "megabatch"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_chained_rlr_avg_async_mb"] = CheckSpec(
+        name="sharded_chained_rlr_avg_async_mb",
+        family="chained_sharded_async_mb", sharded=True,
+        cfg_overrides={**buf, "train_layout": "megabatch",
+                       "chain": 2, "snap": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    # --diagnostics sharded twin: the ONLY addition to the plan is one
+    # all_gather collecting the per-client loss diagnostics across
+    # shards — pinned so a diagnostics refactor cannot silently grow
+    # the round program's communication
+    specs["sharded_rlr_avg_diag"] = CheckSpec(
+        name="sharded_rlr_avg_diag", family="round_sharded_diag",
+        sharded=True, cfg_overrides={"diagnostics": True},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2,
+                           "all_gather": 1},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
     return specs
 
 
@@ -819,3 +925,112 @@ PROGRAM_READ_MODULES = (
 #               causes spurious recompiles — the drift this audit exists
 #               to catch)
 PROVENANCE_CLASSES = ("program", "shape", "data", "runtime")
+
+# --------------------------------------------------------------------------
+# Program-family coverage (analysis/coverage.py)
+# --------------------------------------------------------------------------
+
+# How to TURN ON each compile_cache.family_suffix token. The coverage
+# pass derives the token list from family_suffix's own AST (never a
+# duplicated list); this table only says which config overrides activate
+# a token so the lattice can be enumerated through the real planners. A
+# token the algebra emits with no driver here fails the gate loudly
+# (rule `suffix-unmapped`) — adding a family_suffix branch REQUIRES
+# teaching the coverage pass how to reach it.
+SUFFIX_DRIVERS: Dict[str, Dict[str, object]] = {
+    "_async": {"agg_mode": "buffered"},       # fl/buffered.is_buffered
+    "_mb": {"train_layout": "megabatch"},     # resolved_train_layout
+    "_mt": {"tenants": 2},                    # tenant packs (fl/tenancy)
+}
+
+# Reachable families deliberately carrying NO CheckSpec. Every entry
+# must say WHY no collective-budget pin is needed — a waiver without a
+# reason is a review defect, and a waiver for a family that gains a
+# spec (or stops being reachable) is flagged as stale.
+_W_CHAINED_VMAP = (
+    "vmap chained scan of a collective-free round body: iter_eqns counts "
+    "the scan body once, so a spec here would re-pin exactly the round "
+    "twin's zero collectives; the family's real contract is the donation "
+    "pin (DONATED_FAMILIES + test_chained_families_donate_params)")
+_W_VMAP_CROSS = (
+    "vmap family — collective-free by construction (no mesh); every "
+    "mechanism axis is pinned at zero by its vmap_rlr_avg* "
+    "representative, and the suffix cross-terms compose the same "
+    "collective-free bodies (the sharded twins of these cross-terms "
+    "carry real budgets)")
+_W_VMAP_DIAG = (
+    "diagnostics adds host-visible per-client outputs to a vmap body — "
+    "still collective-free; the sharded diag twin carries the real pin "
+    "(sharded_rlr_avg_diag: +1 all_gather)")
+_W_EVAL_TWIN = (
+    "same traced eval body as the pinned vmap_eval family, on a "
+    "different eval set (the _mt pair is that body vmapped over the "
+    "tenant axis) — collective-free; a divergence would surface in "
+    "vmap_eval's zero pin")
+WAIVED_FAMILIES: Dict[str, str] = {
+    **{f: _W_CHAINED_VMAP for f in (
+        "chained", "chained_async", "chained_async_mb",
+        "chained_async_mb_mt", "chained_async_mt", "chained_cohort",
+        "chained_cohort_async", "chained_cohort_async_mb",
+        "chained_cohort_mb", "chained_host", "chained_host_mb",
+        "chained_mb", "chained_mb_mt", "chained_mt")},
+    **{f: _W_VMAP_CROSS for f in (
+        "round_async_mb_mt", "round_cohort_async",
+        "round_cohort_async_mb", "round_cohort_async_mb_mt",
+        "round_cohort_async_mt", "round_cohort_mb", "round_cohort_mb_mt",
+        "round_host", "round_host_mb", "round_mb_mt")},
+    **{f: _W_VMAP_DIAG for f in (
+        "round_diag", "round_cohort_diag", "round_host_diag")},
+    **{f: _W_EVAL_TWIN for f in (
+        "eval_poison", "eval_val_mt", "eval_poison_mt")},
+}
+
+# Program-provenance config fields deliberately absent from run_name.
+# Every entry must say why two runs differing only in this field MAY
+# share a run dir — the documented escape hatch for the run_name
+# collision rule (the PR-3/11/13 bug class made static).
+_X_REFERENCE_VOCAB = (
+    "the run name reproduces the reference's hyperparameter vocabulary "
+    "(src/federated.py:27-31) — the model/data/local-training axes were "
+    "never in it; sweeps separate them by --log_dir root (scripts/ "
+    "convention) and retro-adding them would orphan every historical "
+    "run dir the curve-parity harness keys on")
+_X_VALUE_PRESERVING = (
+    "value-preserving re-lowering knob: results are bit-identical (or "
+    "pinned ulp-equal by the parity tests), so runs differing only in "
+    "it are the SAME experiment retuned — sharing the dir is the "
+    "resume story, not a collision")
+RUN_NAME_EXEMPT: Dict[str, str] = {
+    "arch": _X_REFERENCE_VOCAB,
+    "data": _X_REFERENCE_VOCAB,
+    "dtype": _X_REFERENCE_VOCAB,
+    "bs": _X_REFERENCE_VOCAB,
+    "local_ep": _X_REFERENCE_VOCAB,
+    "client_lr": _X_REFERENCE_VOCAB,
+    "client_moment": _X_REFERENCE_VOCAB,
+    "agent_chunk": _X_VALUE_PRESERVING,
+    "agg_layout": _X_VALUE_PRESERVING,
+    "remat": _X_VALUE_PRESERVING,
+    "remat_policy": _X_VALUE_PRESERVING,
+    "use_pallas": _X_VALUE_PRESERVING,
+    "debug_nan": (
+        "checkify instrumentation only observes — values are identical, "
+        "and a debugging rerun must land in the dir of the run it is "
+        "debugging"),
+    "telemetry": (
+        "telemetry levels change which scalars are computed, never the "
+        "model update (the telemetry-off bit-identity contract, pinned "
+        "by jaxpr_lint's tripwire) — the metrics stream is "
+        "self-describing about its level"),
+    "health": (
+        "the in-jit sentinel lane only ADDS monitoring reductions; the "
+        "update math is untouched (health on/off value parity is a "
+        "tier-1 pin) — the lane is observability, not experiment "
+        "identity (quarantine, which DOES change results, is in the "
+        "name)"),
+    "tenants": (
+        "the pack width is a scheduling decision: per-tenant metrics "
+        "land under each tenant's OWN run_name (service/tenancy), and "
+        "pack-vs-standalone parity is the acceptance contract — the "
+        "same cell must resolve to the same dir either way"),
+}

@@ -377,7 +377,13 @@ def baseline_path(repo_root: str) -> str:
     return os.path.join(repo_root, BASELINE_NAME)
 
 
-def write_baseline(repo_root: str, baseline: Dict[str, Any]) -> str:
+def write_baseline(repo_root: str, baseline: Dict[str, Any],
+                   prune: bool = False) -> str:
+    """Merge `baseline` into analysis_baseline.json. With `prune=True`
+    (the `--write-baseline` CLI path), records whose spec x topology key
+    no longer exists in contracts.check_specs() are dropped, so the
+    committed file is exactly the live set — the coverage pass's
+    dead-baseline rule then has nothing to flag."""
     path = baseline_path(repo_root)
     existing: Dict[str, Any] = {}
     if os.path.exists(path):
@@ -387,6 +393,18 @@ def write_baseline(repo_root: str, baseline: Dict[str, Any]) -> str:
     merged.update({k: v for k, v in baseline.items() if k != "families"})
     fams = dict(existing.get("families", {}))
     fams.update(baseline["families"])
+    if prune:
+        # imported lazily: coverage imports this module at top level
+        from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+            coverage)
+        live = coverage.live_baseline_keys(repo_root)
+        dead = sorted(set(fams) - live)
+        for key in dead:
+            del fams[key]
+        if dead:
+            import sys
+            print(f"[analysis] baseline: pruned {len(dead)} dead "
+                  f"record(s): {', '.join(dead)}", file=sys.stderr)
     merged["families"] = fams
     with open(path, "w", encoding="utf-8") as f:
         json.dump(merged, f, indent=1, sort_keys=True)

@@ -1,0 +1,667 @@
+"""Host-concurrency race detector over the package source.
+
+The simulator's host plane is genuinely concurrent: the MetricsDrain and
+RoundPrefetcher own worker threads, the tenant pack prefetches on a
+``ThreadPoolExecutor``, the Prometheus exporter serves scrapes from an
+HTTP thread, eval emission rides ``drain.submit`` callbacks, and bank
+builds fan out to spawn-context ``Pool`` processes. Every past torn-
+write/stale-read bug (flight.jsonl tails, leaked writers, interleaved
+run dirs) was found by chaos drills *after* shipping. This pass makes
+the host-concurrency invariants machine-checked from the AST, reusing
+``ast_rules``'s module model and call-graph fixpoint.
+
+**Execution-context graph.** Seeds are the callables handed to
+``threading.Thread(target=...)`` / ``threading.Timer(...)``, to any
+``*.submit(fn, ...)`` (ThreadPoolExecutor and the MetricsDrain share
+that verb — both run ``fn`` on another thread), to spawn-``Pool``
+dispatchers (``imap``/``imap_unordered``/``map_async``/``starmap``/
+``apply_async`` — *process* contexts: separate address space, shared
+filesystem), and every method of a ``BaseHTTPRequestHandler`` subclass
+(server threads). Contexts propagate to callees through the same
+resolution ``ast_rules._propagate_traced`` uses, extended with
+``self.method`` resolution inside a class. Every function additionally
+belongs to the implicit ``main`` context.
+
+A class is **concurrency-shared** when it declares a lock/condition
+(its own statement that its state crosses threads), owns a worker
+(constructs a Thread/Timer/executor), or has a method reachable from a
+non-main thread context. For shared classes the pass checks that every
+instance-state mutation is actually serialized — partial locking is the
+recurring bug class (an exporter that locks ``set`` but not the EMA
+fold, a recorder that locks the ring but not the seq counter).
+
+Rules (ids are stable — they appear in pragmas and ALLOW reasons):
+
+- ``cross-thread-state``  a ``self.attr`` (or declared-``global``)
+                          write outside ``__init__``/construction
+                          helpers, not under a ``with self._lock:`` /
+                          ``_cond``/``_mutex`` block, in a concurrency-
+                          shared class (or a global touched from >= 2
+                          thread contexts). Process contexts are exempt
+                          (no shared memory).
+- ``racy-file-write``     an ``open(..., "w"/"a"/...)`` or ``np.save``
+                          reachable from a non-main context whose path
+                          is not visibly the tmp half of the
+                          ``checkpoint.atomic_write_text`` tmp+rename
+                          idiom and whose function never renames.
+- ``check-then-act``      ``os.path.exists/isdir/isfile(p)`` followed
+                          by an unguarded mutation of the same ``p``
+                          (``os.replace``/``remove``/``rename``/
+                          ``rmdir``/``shutil.rmtree``/write-mode
+                          ``open``) in a concurrent function or a
+                          module that spawns workers — the classic
+                          TOCTOU shape; guard the mutation with
+                          try/except (or ``ignore_errors``) instead.
+
+Suppression is exactly ast_rules's: a justified ``# static: ok(rule)``
+line pragma or a ``contracts.ALLOW[(relpath, qualname)]`` entry whose
+value names the serialization argument. Blanket suppression without a
+reason is what this pass exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
+    contracts)
+from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.ast_rules import (
+    Finding, FuncInfo, ModuleInfo, _allowed, _attr_chain, _emit,
+    _own_nodes, _suppressed, _terminal_name, default_paths, load_module)
+
+MAIN = "main"
+
+# callables whose first argument runs on another THREAD
+_THREAD_DISPATCH = frozenset({"submit"})
+# callables whose first argument runs in a worker PROCESS (spawn Pool)
+_PROCESS_DISPATCH = frozenset({"imap", "imap_unordered", "map_async",
+                               "starmap", "starmap_async", "apply_async"})
+# constructing one of these marks the enclosing class as owning a worker
+_WORKER_CTORS = frozenset({"Thread", "Timer", "ThreadPoolExecutor",
+                           "ProcessPoolExecutor"})
+# constructing one of these is the class's own declaration that its
+# state crosses threads
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+# names that make a `with self.<name>:` block count as a critical section
+_LOCKISH = ("lock", "cond", "mutex", "sem")
+# container methods that mutate the receiver in place
+_MUTATORS = frozenset({"append", "appendleft", "extend", "insert",
+                       "remove", "popleft", "update", "setdefault",
+                       "add", "discard", "rotate"})
+# threading primitives serialize themselves: calling these on an attr is
+# not an unprotected mutation of OUR state
+_PRIMITIVE_METHODS = frozenset({"set", "clear", "wait", "notify",
+                                "notify_all", "acquire", "release",
+                                "put", "put_nowait", "get", "get_nowait",
+                                "join", "task_done", "close"})
+_PATH_CHECKS = frozenset({"exists", "isdir", "isfile", "islink"})
+_PATH_MUTATORS = frozenset({"replace", "remove", "rename", "rmdir",
+                            "unlink", "rmtree"})
+_CONSTRUCTORS = ("__init__", "__post_init__", "__enter__")
+
+
+@dataclasses.dataclass(frozen=True)
+class Context:
+    """One spawn site: where a second flow of control enters the code."""
+    kind: str      # "thread" | "process"
+    site: str      # "relpath:lineno" — distinct sites, distinct contexts
+
+    def __str__(self) -> str:
+        return f"{self.kind}@{self.site}"
+
+
+@dataclasses.dataclass
+class _Access:
+    fi: FuncInfo
+    node: ast.AST
+    write: bool
+    locked: bool
+    construction: bool
+
+
+# --------------------------------------------------------------------------
+# module shape: classes, lock regions
+# --------------------------------------------------------------------------
+
+def _class_of_funcs(mod: ModuleInfo) -> Dict[int, str]:
+    """id(FunctionDef node) -> innermost enclosing class name."""
+    out: Dict[int, str] = {}
+
+    def walk(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                if cls is not None:
+                    out[id(child)] = cls
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(mod.tree, None)
+    return out
+
+
+def _class_bases(mod: ModuleInfo) -> Dict[str, Set[str]]:
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = {_terminal_name(b) for b in node.bases}
+    return out
+
+
+def _lockish_with(node: ast.With) -> bool:
+    for item in node.items:
+        chain = _attr_chain(item.context_expr)
+        name = chain[-1].lower()
+        if any(tok in name for tok in _LOCKISH):
+            return True
+    return False
+
+
+def _lock_regions(fi: FuncInfo) -> List[Tuple[int, int]]:
+    """(start, end) line spans of `with <lockish>:` blocks in fi."""
+    regions: List[Tuple[int, int]] = []
+    for node in _own_nodes(fi):
+        if isinstance(node, ast.With) and _lockish_with(node):
+            regions.append((node.lineno,
+                            node.end_lineno or node.lineno))
+    return regions
+
+
+def _in_regions(line: int, regions: List[Tuple[int, int]]) -> bool:
+    return any(a <= line <= b for a, b in regions)
+
+
+# --------------------------------------------------------------------------
+# context seeding + propagation
+# --------------------------------------------------------------------------
+
+def _resolver(mods: Dict[str, ModuleInfo], classes: Dict[str, Dict[int, str]]):
+    """ast_rules-style call resolution, plus `self.method` within the
+    caller's own class."""
+    by_dotted = {m.dotted: m for m in mods.values() if m.dotted}
+
+    def resolve(fi: FuncInfo, term: str,
+                base: Optional[str]) -> List[FuncInfo]:
+        mod = fi.module
+        out: List[FuncInfo] = []
+        if base is None:
+            out.extend(mod.by_name.get(term, ()))
+            imp = mod.imports.get(term)
+            if imp and imp[1] is not None:
+                tm = by_dotted.get(imp[0])
+                if tm is not None:
+                    out.extend(tm.by_name.get(imp[1], ()))
+        elif base == "self":
+            cls = classes[mod.relpath].get(id(fi.node))
+            if cls is not None:
+                out.extend(f for f in mod.by_name.get(term, ())
+                           if classes[mod.relpath].get(id(f.node)) == cls)
+        else:
+            imp = mod.imports.get(base)
+            if imp is not None:
+                dotted = imp[0] if imp[1] is None else f"{imp[0]}.{imp[1]}"
+                tm = by_dotted.get(dotted)
+                if tm is not None:
+                    out.extend(tm.by_name.get(term, ()))
+        return out
+
+    return resolve
+
+
+def _spawn_target(call: ast.Call) -> Optional[Tuple[ast.AST, str]]:
+    """(target_expr, kind) when `call` hands a callable to another
+    execution context; None otherwise."""
+    term = _terminal_name(call.func)
+    if term == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value, "thread"
+        return None
+    if term == "Timer" and len(call.args) >= 2:
+        return call.args[1], "thread"
+    if term in _THREAD_DISPATCH and call.args:
+        return call.args[0], "thread"
+    if term in _PROCESS_DISPATCH and call.args:
+        # only attribute calls (pool.imap_unordered) — a bare imap() name
+        # collision should not spawn a phantom context
+        if isinstance(call.func, ast.Attribute):
+            return call.args[0], "process"
+    return None
+
+
+def _seed_contexts(mods: Dict[str, ModuleInfo],
+                   classes: Dict[str, Dict[int, str]],
+                   resolve) -> Dict[int, Set[Context]]:
+    ctxs: Dict[int, Set[Context]] = {}
+
+    def add(target: FuncInfo, ctx: Context) -> None:
+        ctxs.setdefault(id(target.node), set()).add(ctx)
+
+    for mod in mods.values():
+        bases = _class_bases(mod)
+        handler_classes = {c for c, bs in bases.items()
+                           if any("RequestHandler" in b for b in bs)}
+        for fi in mod.funcs:
+            cls = classes[mod.relpath].get(id(fi.node))
+            if cls in handler_classes:
+                add(fi, Context("thread", f"{mod.relpath}:{cls}"))
+            for node in _own_nodes(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                spawned = _spawn_target(node)
+                if spawned is None:
+                    continue
+                expr, kind = spawned
+                ctx = Context(kind, f"{mod.relpath}:{node.lineno}")
+                if isinstance(expr, ast.Name):
+                    for t in resolve(fi, expr.id, None):
+                        add(t, ctx)
+                elif isinstance(expr, ast.Attribute):
+                    root = expr.value
+                    base = root.id if isinstance(root, ast.Name) else None
+                    for t in resolve(fi, expr.attr, base):
+                        add(t, ctx)
+    return ctxs
+
+
+def _propagate_contexts(mods: Dict[str, ModuleInfo],
+                        ctxs: Dict[int, Set[Context]], resolve) -> None:
+    """Fixpoint: a callee runs in every context its callers run in."""
+    work = [fi for m in mods.values() for fi in m.funcs
+            if id(fi.node) in ctxs]
+    # nested defs share their parent's flow of control
+    for m in mods.values():
+        for fi in m.funcs:
+            if fi.parent is not None and id(fi.parent.node) in ctxs:
+                work.append(fi)
+    while work:
+        fi = work.pop()
+        have = ctxs.get(id(fi.node), set())
+        if fi.parent is not None:
+            inherited = ctxs.get(id(fi.parent.node), set()) - have
+            if inherited:
+                ctxs.setdefault(id(fi.node), set()).update(inherited)
+                have = ctxs[id(fi.node)]
+        for term, base, _ in fi.calls:
+            for target in resolve(fi, term, base):
+                got = ctxs.setdefault(id(target.node), set())
+                new = have - got
+                if new:
+                    got.update(new)
+                    work.append(target)
+        for m2 in (fi.module,):
+            for sub in m2.funcs:
+                if sub.parent is fi and (have
+                                         - ctxs.get(id(sub.node), set())):
+                    ctxs.setdefault(id(sub.node), set()).update(have)
+                    work.append(sub)
+
+
+# --------------------------------------------------------------------------
+# shared-class discovery + attribute access model
+# --------------------------------------------------------------------------
+
+def _constructs(fi: FuncInfo, names: frozenset) -> bool:
+    return any(term in names for term, _base, _ln in fi.calls)
+
+
+def _shared_classes(mod: ModuleInfo, classes: Dict[int, str],
+                    ctxs: Dict[int, Set[Context]]) -> Dict[str, str]:
+    """class -> tier. ``declared``: the class constructs a lock — its own
+    statement that state crosses threads, so EVERY unlocked mutation is a
+    partial-locking hazard (the exporter-EMA bug class). ``reachable``:
+    some method runs on a worker thread — only attrs that two different
+    context signatures actually touch are hazards (a dispatch-side field
+    a drain callback never reads is single-threaded in practice)."""
+    shared: Dict[str, str] = {}
+    for fi in mod.funcs:
+        cls = classes.get(id(fi.node))
+        if cls is None:
+            continue
+        if _constructs(fi, _WORKER_CTORS) or \
+                any(c.kind == "thread" for c in ctxs.get(id(fi.node), ())):
+            shared.setdefault(cls, "reachable")
+        if _constructs(fi, _LOCK_CTORS):
+            shared[cls] = "declared"
+    return shared
+
+
+def _construction_only(mod: ModuleInfo, classes: Dict[int, str]) -> Set[int]:
+    """id(node) of methods called ONLY from their class's constructors
+    (directly or transitively) — construction-phase helpers like
+    ``_recover_tail`` whose writes precede any second context."""
+    by_class: Dict[str, List[FuncInfo]] = {}
+    for fi in mod.funcs:
+        cls = classes.get(id(fi.node))
+        if cls is not None and fi.parent is None:
+            by_class.setdefault(cls, []).append(fi)
+    out: Set[int] = set()
+    for cls, methods in by_class.items():
+        named = {m.node.name: m for m in methods}
+        callers: Dict[str, Set[str]] = {name: set() for name in named}
+        for m in methods:
+            for term, base, _ in m.calls:
+                if base == "self" and term in callers:
+                    callers[term].add(m.node.name)
+
+        def ctor_only(name: str, seen: Set[str]) -> bool:
+            if name in seen:
+                return True
+            seen.add(name)
+            cs = callers[name]
+            return bool(cs) and all(
+                c in _CONSTRUCTORS or ctor_only(c, seen) for c in cs)
+
+        for name, m in named.items():
+            if name not in _CONSTRUCTORS and ctor_only(name, set()):
+                out.add(id(m.node))
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _attr_accesses(fi: FuncInfo, regions: List[Tuple[int, int]],
+                   construction: bool) -> List[Tuple[str, _Access]]:
+    out: List[Tuple[str, _Access]] = []
+    # the `_locked` suffix is this codebase's caller-holds-the-lock
+    # contract (MetricsDrain._raise_pending_locked); honor it
+    caller_locked = fi.node.name.endswith("_locked")
+
+    def rec(attr: str, node: ast.AST, write: bool) -> None:
+        out.append((attr, _Access(
+            fi=fi, node=node, write=write,
+            locked=caller_locked or _in_regions(node.lineno, regions),
+            construction=construction)))
+
+    for node in _own_nodes(fi):
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and not isinstance(node.ctx, ast.Load):
+                rec(attr, node, True)
+            elif attr is not None:
+                rec(attr, node, False)
+        elif isinstance(node, ast.Subscript):
+            attr = _self_attr(node.value)
+            if attr is not None and not isinstance(node.ctx, ast.Load):
+                rec(attr, node, True)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                if node.func.attr in _MUTATORS:
+                    rec(attr, node, True)
+                elif node.func.attr in _PRIMITIVE_METHODS:
+                    # Event.set / Queue.put / Lock.acquire: the primitive
+                    # is its own critical section
+                    pass
+    return out
+
+
+# --------------------------------------------------------------------------
+# rule 1: cross-thread state
+# --------------------------------------------------------------------------
+
+def _check_shared_state(mod: ModuleInfo, classes: Dict[int, str],
+                        ctxs: Dict[int, Set[Context]],
+                        findings: List[Finding]) -> None:
+    shared = _shared_classes(mod, classes, ctxs)
+    if shared:
+        ctor_only = _construction_only(mod, classes)
+        per_class: Dict[str, Dict[str, List[_Access]]] = {}
+        for fi in mod.funcs:
+            cls = classes.get(id(fi.node))
+            owner = fi
+            while owner.parent is not None:
+                owner = owner.parent
+            if cls is None:
+                cls = classes.get(id(owner.node))
+            if cls not in shared:
+                continue
+            construction = (owner.node.name in _CONSTRUCTORS
+                            or id(owner.node) in ctor_only)
+            regions = _lock_regions(fi)
+            for attr, acc in _attr_accesses(fi, regions, construction):
+                per_class.setdefault(cls, {}).setdefault(
+                    attr, []).append(acc)
+        def sig(a: _Access) -> frozenset:
+            # a method with a worker context is assumed to run THERE; a
+            # method with none runs on the dispatching (main) thread
+            return frozenset(ctxs.get(id(a.fi.node), ()))
+
+        for cls, attrs in per_class.items():
+            for attr, accesses in attrs.items():
+                methods = {a.fi.qualname for a in accesses}
+                reads_elsewhere = len(methods) > 1 or any(
+                    not a.write for a in accesses)
+                if not reads_elsewhere:
+                    continue   # write-only scratch never observed
+                for a in accesses:
+                    if not a.write or a.locked or a.construction:
+                        continue
+                    if shared[cls] == "declared":
+                        _emit(findings, mod, a.fi, a.node,
+                              "cross-thread-state",
+                              f"{cls}.{attr} is mutated outside the "
+                              f"critical section of a class that "
+                              "declares a lock — hold the lock, or "
+                              "record the serialization argument in an "
+                              "ALLOW entry / pragma")
+                    elif any(sig(b) != sig(a) for b in accesses):
+                        _emit(findings, mod, a.fi, a.node,
+                              "cross-thread-state",
+                              f"{cls}.{attr} is touched from two "
+                              "execution contexts and this write holds "
+                              "no lock — serialize it, or record the "
+                              "ordering argument in an ALLOW entry / "
+                              "pragma")
+
+    # module-global state written from >= 2 thread contexts
+    global_writers: Dict[str, List[Tuple[FuncInfo, ast.AST]]] = {}
+    global_ctxs: Dict[str, Set[str]] = {}
+    for fi in mod.funcs:
+        declared = {n for node in _own_nodes(fi)
+                    if isinstance(node, ast.Global) for n in node.names}
+        if not declared:
+            continue
+        fctx = {str(c) for c in ctxs.get(id(fi.node), ())
+                if c.kind == "thread"} | {MAIN}
+        for node in _own_nodes(fi):
+            if isinstance(node, ast.Name) and node.id in declared and \
+                    not isinstance(node.ctx, ast.Load):
+                global_writers.setdefault(node.id, []).append((fi, node))
+                global_ctxs.setdefault(node.id, set()).update(fctx)
+    for name, writers in global_writers.items():
+        if len(global_ctxs.get(name, set())) < 2:
+            continue
+        for fi, node in writers:
+            regions = _lock_regions(fi)
+            if _in_regions(node.lineno, regions):
+                continue
+            _emit(findings, mod, fi, node, "cross-thread-state",
+                  f"module global '{name}' is written on a worker "
+                  "thread without a lock")
+
+
+# --------------------------------------------------------------------------
+# rule 2: non-atomic file writes off the main thread
+# --------------------------------------------------------------------------
+
+_WRITE_MODES = ("w", "a", "x", "+")
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    if _terminal_name(call.func) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and any(c in mode for c in _WRITE_MODES)
+
+
+def _path_mentions_tmp(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "tmp" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Constant) and \
+                isinstance(sub.value, str) and "tmp" in sub.value.lower():
+            return True
+    return False
+
+
+def _check_file_writes(mod: ModuleInfo, ctxs: Dict[int, Set[Context]],
+                       findings: List[Finding]) -> None:
+    for fi in mod.funcs:
+        if not ctxs.get(id(fi.node)):
+            continue   # main-thread-only: snapshot atomicity is rule 3's
+        renames = any(term in ("replace", "rename")
+                      for term, _b, _ln in fi.calls)
+        uses_atomic = any(term == "atomic_write_text"
+                          for term, _b, _ln in fi.calls)
+        if renames or uses_atomic:
+            continue   # the tmp+rename idiom, by construction
+        for node in _own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            target: Optional[ast.AST] = None
+            if _open_write_mode(node):
+                target = node.args[0] if node.args else None
+            elif _terminal_name(node.func) == "save" and node.args and \
+                    isinstance(node.func, ast.Attribute):
+                chain = _attr_chain(node.func)
+                if chain[0] in ("np", "numpy", "jnp"):
+                    target = node.args[0]
+            if target is None or _path_mentions_tmp(target):
+                continue
+            _emit(findings, mod, fi, node, "racy-file-write",
+                  f"{fi.qualname} runs off the main thread and writes a "
+                  "file in place; use checkpoint.atomic_write_text or "
+                  "the tmp+os.replace idiom so a concurrent reader "
+                  "never sees a torn file")
+
+
+# --------------------------------------------------------------------------
+# rule 3: check-then-act on shared paths
+# --------------------------------------------------------------------------
+
+def _guarded(node: ast.AST, fi: FuncInfo) -> bool:
+    """Inside a try with handlers, or called with ignore_errors=True /
+    missing_ok=True — the race is acknowledged and absorbed."""
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg in ("ignore_errors", "missing_ok") and \
+                    isinstance(kw.value, ast.Constant) and kw.value.value:
+                return True
+    line = node.lineno
+    for sub in _own_nodes(fi):
+        if isinstance(sub, ast.Try) and sub.handlers:
+            body_end = max((s.end_lineno or s.lineno) for s in sub.body)
+            if sub.lineno <= line <= body_end:
+                return True
+    return False
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """A stable key for simple path expressions: names and dotted
+    chains. Complex expressions are not tracked (no false anchors)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        chain = _attr_chain(node)
+        if chain[0]:
+            return ".".join(chain)
+    return None
+
+
+def _check_check_then_act(mod: ModuleInfo, ctxs: Dict[int, Set[Context]],
+                          findings: List[Finding]) -> None:
+    module_spawns = any(ctxs.get(id(fi.node)) for fi in mod.funcs)
+    for fi in mod.funcs:
+        concurrent = bool(ctxs.get(id(fi.node))) or module_spawns
+        if not concurrent:
+            continue
+        # two passes: _own_nodes gives no source-order guarantee, and the
+        # check may be visited after the mutation it guards — collect
+        # every existence check first, then judge mutators by line
+        checked: Dict[str, int] = {}
+        for node in _own_nodes(fi):
+            if isinstance(node, ast.Call) and \
+                    _terminal_name(node.func) in _PATH_CHECKS and node.args:
+                key = _expr_key(node.args[0])
+                if key is not None:
+                    checked[key] = min(checked.get(key, node.lineno),
+                                       node.lineno)
+        for node in _own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal_name(node.func)
+            if term in _PATH_CHECKS:
+                continue
+            mutates: Optional[str] = None
+            if term in _PATH_MUTATORS and node.args:
+                mutates = _expr_key(node.args[0])
+                if term == "replace" and len(node.args) >= 2:
+                    mutates = mutates or _expr_key(node.args[1])
+            elif _open_write_mode(node) and node.args:
+                mutates = _expr_key(node.args[0])
+            if mutates is None or mutates not in checked:
+                continue
+            if node.lineno <= checked[mutates]:
+                continue
+            if _guarded(node, fi):
+                continue
+            _emit(findings, mod, fi, node, "check-then-act",
+                  f"'{mutates}' was existence-checked at line "
+                  f"{checked[mutates]} and is mutated here without a "
+                  "guard; another worker can win the window — wrap the "
+                  "mutation in try/except (tolerate the loss) instead "
+                  "of trusting the check")
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+def scan(paths: Sequence[str], repo_root: str) -> List[Finding]:
+    """Run the host-concurrency rules over `paths`; findings sorted by
+    location."""
+    mods: Dict[str, ModuleInfo] = {}
+    for path in paths:
+        mod = load_module(path, repo_root)
+        mods[mod.relpath] = mod
+    classes = {rel: _class_of_funcs(m) for rel, m in mods.items()}
+    resolve = _resolver(mods, classes)
+    ctxs = _seed_contexts(mods, classes, resolve)
+    _propagate_contexts(mods, ctxs, resolve)
+    # shared memory needs shared address space: process contexts drive
+    # only the file rules
+    thread_ctxs = {k: {c for c in v if c.kind == "thread"}
+                   for k, v in ctxs.items()}
+    thread_ctxs = {k: v for k, v in thread_ctxs.items() if v}
+
+    findings: List[Finding] = []
+    for mod in mods.values():
+        _check_shared_state(mod, classes[mod.relpath], thread_ctxs,
+                            findings)
+        _check_file_writes(mod, ctxs, findings)
+        _check_check_then_act(mod, ctxs, findings)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def scan_repo(repo_root: str) -> List[Finding]:
+    return scan(default_paths(repo_root), repo_root)

@@ -111,17 +111,22 @@ class MetricsExporter:
     def observe_rounds(self, rounds_total: float) -> None:
         """Fold an absolute round count into the rounds/sec EMA."""
         now = self._clock()
-        if self._last_obs is not None:
-            last_t, last_r = self._last_obs
-            dt, dr = now - last_t, rounds_total - last_r
-            if dt > 0 and dr > 0:
-                rate = dr / dt
-                self._ema = (rate if self._ema is None
-                             else EMA_ALPHA * rate
-                             + (1 - EMA_ALPHA) * self._ema)
-        self._last_obs = (now, rounds_total)
-        if self._ema is not None:
-            self.set("rounds_per_sec_ema", self._ema,
+        # fold under the lock (set() re-acquires it afterwards): the
+        # EMA read-modify-write must not interleave with another
+        # observer's fold
+        with self._lock:
+            if self._last_obs is not None:
+                last_t, last_r = self._last_obs
+                dt, dr = now - last_t, rounds_total - last_r
+                if dt > 0 and dr > 0:
+                    rate = dr / dt
+                    self._ema = (rate if self._ema is None
+                                 else EMA_ALPHA * rate
+                                 + (1 - EMA_ALPHA) * self._ema)
+            self._last_obs = (now, rounds_total)
+            ema = self._ema
+        if ema is not None:
+            self.set("rounds_per_sec_ema", ema,
                      help_text="EMA of observed rounds/sec")
         self.set("rounds_observed_total", rounds_total, mtype="counter",
                  help_text="latest absolute round count observed")
@@ -157,7 +162,9 @@ class MetricsExporter:
                 f.write(self.render())
             os.replace(tmp, self.textfile)
         except OSError:
-            self.enabled = False   # observability never takes down the run
+            # observability never takes down the run
+            with self._lock:
+                self.enabled = False
 
     def close(self) -> None:
         self.flush()

@@ -157,7 +157,8 @@ class FlightRecorder:
         """Mark the start of a dispatch unit (for the dispatch-gap
         clock)."""
         if self.enabled:
-            self._t_begin = time.perf_counter()
+            with self._lock:
+                self._t_begin = time.perf_counter()
 
     def end_unit(self, rnd: int, unit_rounds: int = 1,
                  drain_depth: Optional[int] = None
@@ -170,50 +171,55 @@ class FlightRecorder:
         if not self.enabled:
             return None
         now = time.perf_counter()
+        # one critical section end to end: the drain thread's
+        # observe_span must never interleave with the seq/hw/stream
+        # mutation (the torn-tail bug class this recorder exists to
+        # catch must not live in the recorder itself)
         with self._lock:
             spans, self._spans = self._spans, {}
             notes, self._notes = self._notes, {}
-        gap_ms = (round((self._t_begin - self._t_last_end) * 1e3, 3)
-                  if self._t_begin is not None
-                  and self._t_last_end is not None else None)
-        self._t_last_end = now
-        replay = rnd <= self.hw
-        # fixed field order: the non-timing head first, then the
-        # timing/volatile tail, then the wall stamp — the strip_timing
-        # projection of identical round sequences is byte-identical
-        rec: Dict[str, Any] = {
-            "seq": self.seq, "v": 1, "round": rnd, "corr": self.corr,
-            "slot": self.slot, "rounds": unit_rounds,
-            "gap_ms": gap_ms, "spans": spans,
-            "drain_depth": drain_depth,
-            "buffer_fill": notes.get("buffer_fill"),
-            "hbm_live_bytes": notes.get("hbm_live_bytes"),
-            "hbm_peak_bytes": notes.get("hbm_peak_bytes"),
-            "t": self._clock(),
-        }
-        if replay:
-            # refresh the ring's view of the replayed round (the fresh
-            # record carries this life's real timings) without touching
-            # the stream — and without consuming a seq
-            rec["seq"] = next(
-                (r["seq"] for r in self._ring if r.get("round") == rnd),
-                self.seq)
-            with self._lock:
+            gap_ms = (round((self._t_begin - self._t_last_end) * 1e3, 3)
+                      if self._t_begin is not None
+                      and self._t_last_end is not None else None)
+            self._t_last_end = now
+            replay = rnd <= self.hw
+            # fixed field order: the non-timing head first, then the
+            # timing/volatile tail, then the wall stamp — the
+            # strip_timing projection of identical round sequences is
+            # byte-identical
+            rec: Dict[str, Any] = {
+                "seq": self.seq, "v": 1, "round": rnd, "corr": self.corr,
+                "slot": self.slot, "rounds": unit_rounds,
+                "gap_ms": gap_ms, "spans": spans,
+                "drain_depth": drain_depth,
+                "buffer_fill": notes.get("buffer_fill"),
+                "hbm_live_bytes": notes.get("hbm_live_bytes"),
+                "hbm_peak_bytes": notes.get("hbm_peak_bytes"),
+                "t": self._clock(),
+            }
+            if replay:
+                # refresh the ring's view of the replayed round (the
+                # fresh record carries this life's real timings) without
+                # touching the stream — and without consuming a seq
+                rec["seq"] = next(
+                    (r["seq"] for r in self._ring
+                     if r.get("round") == rnd),
+                    self.seq)
                 kept = [r for r in self._ring if r.get("round") != rnd]
                 self._ring.clear()
                 self._ring.extend(kept)
                 self._ring.append(rec)
-            return None
-        if self._f is not None:
-            try:
-                self._f.write((json.dumps(rec) + "\n").encode())
-                self._f.flush()
-            except (OSError, ValueError):
-                self.enabled = False   # observability never downs the run
                 return None
-        self.seq += 1
-        self.hw = rnd
-        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.write((json.dumps(rec) + "\n").encode())
+                    self._f.flush()
+                except (OSError, ValueError):
+                    # observability never downs the run
+                    self.enabled = False
+                    return None
+            self.seq += 1
+            self.hw = rnd
             self._ring.append(rec)
         return rec
 
@@ -259,12 +265,13 @@ class FlightRecorder:
         """Close the stream handle; the ring (and ``snapshot``) stay
         usable — the driver snapshots the recovery re-entry AFTER the
         engine teardown closed the stream."""
-        if self._f is not None:
-            try:
-                self._f.close()
-            except OSError:
-                pass
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
 
 
 # --------------------------------------------------------------------------

@@ -99,17 +99,18 @@ class SpanTracer:
         """Add a second completion hook after the existing one (the
         flight recorder chains onto the heartbeat's last-span hook;
         each hook is isolated — one failing never starves the other)."""
-        prev = self._on_end
+        with self._lock:
+            prev = self._on_end
 
-        def chained(name: str, dur_s: float) -> None:
-            if prev is not None:
-                try:
-                    prev(name, dur_s)
-                except Exception:
-                    pass
-            hook(name, dur_s)
+            def chained(name: str, dur_s: float) -> None:
+                if prev is not None:
+                    try:
+                        prev(name, dur_s)
+                    except Exception:
+                        pass
+                hook(name, dur_s)
 
-        self._on_end = chained
+            self._on_end = chained
 
     def _record(self, name: str, start: float, dur: float, depth: int,
                 args: Dict[str, Any]) -> None:

@@ -34,10 +34,17 @@ def run_name(cfg) -> str:
         # every fault knob that changes the experiment must be in the name:
         # two sweep cells differing only in threshold mode / spare-corrupt
         # used to collide into one run dir and interleave their
-        # metrics.jsonl streams
+        # metrics.jsonl streams. corrupt_mode / straggler_epochs ride the
+        # cell at non-default values only (the coverage pass's
+        # run-name-blind rule caught both; default-valued names keep
+        # every historical run dir)
         faults = (f"-flt:d{cfg.dropout_rate}"
                   f"s{cfg.straggler_rate}c{cfg.corrupt_rate}"
-                  f"-thrm:{cfg.rlr_threshold_mode}"
+                  + (f"m{cfg.corrupt_mode}"
+                     if cfg.corrupt_mode != "nan" else "")
+                  + (f"e{cfg.straggler_epochs}"
+                     if cfg.straggler_epochs != 1 else "")
+                  + f"-thrm:{cfg.rlr_threshold_mode}"
                   + ("-spare" if cfg.faults_spare_corrupt else ""))
     churn = ""
     if cfg.churn_enabled:
@@ -49,9 +56,17 @@ def run_name(cfg) -> str:
     if cfg.traffic_enabled:
         # diurnal-traffic cell (ISSUE 17): same collision rule; "flat"
         # stays cell-free so every historical run dir is preserved
+        # the latency sigma shapes the buffered-mode staleness draw
+        # (data/traffic.py) — it rides the cell only in buffered mode,
+        # where it changes the experiment (run-name-blind rule; sync
+        # traffic names stay historical)
+        from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
+            buffered as _buffered)
         traffic = (f"-tfc:{cfg.traffic}p{cfg.traffic_peak_frac}"
                    f"t{cfg.traffic_trough_frac}d{cfg.traffic_day_rounds}"
-                   f"s{cfg.traffic_seed}")
+                   + (f"l{cfg.traffic_latency_sigma}"
+                      if _buffered.is_buffered(cfg) else "")
+                   + f"s{cfg.traffic_seed}")
     cohort = ""
     from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
         compile_cache)
@@ -99,6 +114,14 @@ def run_name(cfg) -> str:
         # runs stay cell-free so every historical dir is preserved
         agm = (f"-agm:bufK{buffered.buffer_k(cfg)}"
                f"a{cfg.async_staleness_exp}S{cfg.async_max_staleness}")
+    qrt = ""
+    if cfg.quarantine:
+        # static quarantine list (ISSUE 14): excluding clients from the
+        # aggregate changes the experiment's results, so two cells
+        # differing only in the exclusion list must not share a run dir
+        # (run-name-blind rule; the empty default stays cell-free so
+        # every historical dir is preserved)
+        qrt = f"-qrt:{str(cfg.quarantine).replace(',', '.')}"
     layout = ""
     if compile_cache.resolved_train_layout(cfg) == "megabatch":
         # training-layout cell (ISSUE 10): megabatch results are only
@@ -112,7 +135,7 @@ def run_name(cfg) -> str:
             f"-s_lr:{cfg.effective_server_lr}-num_cor:{cfg.num_corrupt}"
             f"-thrs_robustLR:{cfg.robustLR_threshold}"
             f"-pttrn:{cfg.pattern_type}-seed:{cfg.seed}"
-            f"{faults}{churn}{traffic}{cohort}{atk}{agm}{layout}")
+            f"{faults}{churn}{traffic}{cohort}{atk}{agm}{qrt}{layout}")
 
 
 class NullWriter:

@@ -17,7 +17,8 @@ import jax
 import pytest
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.analysis import (
-    ast_rules, contracts, fingerprint_audit, jaxpr_lint)
+    ast_rules, contracts, coverage, fingerprint_audit, jaxpr_lint,
+    thread_rules)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -595,3 +596,329 @@ def test_async_budgets_and_baseline_pins():
                 "sharded_rlr_avg_async_faults"):
         assert pinned[key]["collectives"] == {"all_gather": 1,
                                               "psum": 17}, key
+
+# --------------------------------------------------------------------------
+# thread rules (host-concurrency races): synthetic snippets + clean gate
+# --------------------------------------------------------------------------
+
+def _scan_threads(tmp_path, source, relpath="scripts/drain_demo.py"):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return thread_rules.scan([str(path)], str(tmp_path))
+
+
+def test_cross_thread_write_trips_and_locked_twin(tmp_path):
+    bad = """
+    import threading
+
+    class Drain:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = []
+            self._t = threading.Thread(target=self._worker)
+            self._t.start()
+
+        def _worker(self):
+            self._rows = []          # unlocked write on the worker
+
+        def push(self, row):
+            with self._lock:
+                self._rows.append(row)
+    """
+    f = _scan_threads(tmp_path, bad)
+    assert _rules(f) == ["cross-thread-state"]
+    assert any("_rows" in x.message for x in f)
+
+    clean = bad.replace(
+        "            self._rows = []          # unlocked write on the worker",
+        "            with self._lock:\n"
+        "                self._rows = []")
+    assert _scan_threads(tmp_path, clean) == []
+
+
+def test_cross_thread_write_pragma_suppression(tmp_path):
+    src = """
+    import threading
+
+    class Drain:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = []
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            # static: ok(cross-thread-state)
+            self._rows = []
+
+        def push(self, row):
+            with self._lock:
+                self._rows.append(row)
+    """
+    assert _scan_threads(tmp_path, src) == []
+
+
+def test_racy_file_write_trips_and_atomic_twin(tmp_path):
+    bad = """
+    import threading
+
+    def _worker(path):
+        with open(path, "w") as f:
+            f.write("x")
+
+    def start(path):
+        threading.Thread(target=_worker, args=(path,)).start()
+    """
+    assert _rules(_scan_threads(tmp_path, bad)) == ["racy-file-write"]
+
+    clean = """
+    import os
+    import threading
+
+    def _worker(path):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("x")
+        os.replace(tmp, path)
+
+    def start(path):
+        threading.Thread(target=_worker, args=(path,)).start()
+    """
+    assert _scan_threads(tmp_path, clean) == []
+
+
+def test_check_then_act_trips_and_guarded_twin(tmp_path):
+    bad = """
+    import os
+    import threading
+
+    def _worker(path):
+        if os.path.exists(path):
+            os.remove(path)
+
+    def start(path):
+        threading.Thread(target=_worker, args=(path,)).start()
+    """
+    f = _scan_threads(tmp_path, bad)
+    assert _rules(f) == ["check-then-act"]
+
+    clean = """
+    import os
+    import threading
+
+    def _worker(path):
+        if os.path.exists(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass        # another worker won the window
+
+    def start(path):
+        threading.Thread(target=_worker, args=(path,)).start()
+    """
+    assert _scan_threads(tmp_path, clean) == []
+
+
+def test_repo_thread_scan_is_clean():
+    """Satellite contract: every race finding on the tree is fixed or
+    carries a written serialization argument (contracts.ALLOW)."""
+    findings = thread_rules.scan_repo(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# --------------------------------------------------------------------------
+# coverage (program-family lattice): synthetic lattices + clean gate
+# --------------------------------------------------------------------------
+
+def _cov_spec(name, family, sharded=False):
+    return contracts.CheckSpec(name=name, family=family, sharded=sharded,
+                               cfg_overrides={}, collective_budget={})
+
+
+def _cov_kwargs(**over):
+    """A minimal synthetic lattice that audits clean; each test perturbs
+    exactly one input."""
+    base = dict(
+        tokens=["_async"],
+        drivers={"_async": {"agg_mode": "buffered"}},
+        reachable={"round": ["dense"], "chained": ["dense+chain"]},
+        specs={"pin_round": _cov_spec("pin_round", "round"),
+               "pin_chained": _cov_spec("pin_chained", "chained")},
+        baseline={"families": {"pin_round": {}, "pin_chained": {}}},
+        donated=("chained",),
+        waived={},
+        program_fields=set(),
+        run_fields=set(),
+        exempt={},
+        topologies=(contracts.REFERENCE_TOPOLOGY,),
+    )
+    base.update(over)
+    return base
+
+
+def test_coverage_synthetic_lattice_is_clean():
+    assert coverage.audit(REPO, **_cov_kwargs()) == []
+
+
+def test_coverage_missing_pin_for_reachable_family():
+    kw = _cov_kwargs(reachable={"round": ["dense"],
+                                "round_async": ["dense+_async"],
+                                "chained": ["dense+chain"]})
+    f = coverage.audit(REPO, **kw)
+    assert _rules(f) == ["missing-pin"]
+    assert "round_async" in f[0].message
+    # a waiver with a written reason covers it...
+    kw["waived"] = {"round_async": "no mesh: collective-free twin"}
+    assert coverage.audit(REPO, **kw) == []
+    # ...but an empty reason does not
+    kw["waived"] = {"round_async": "  "}
+    assert _rules(coverage.audit(REPO, **kw)) == ["missing-pin"]
+
+
+def test_coverage_stale_waiver():
+    kw = _cov_kwargs(waived={"ghost": "never emitted"})
+    f = coverage.audit(REPO, **kw)
+    assert _rules(f) == ["stale-waiver"] and "ghost" in f[0].message
+    kw = _cov_kwargs(waived={"round": "already has a spec"})
+    assert _rules(coverage.audit(REPO, **kw)) == ["stale-waiver"]
+
+
+def test_coverage_dead_spec():
+    kw = _cov_kwargs()
+    kw["specs"] = dict(kw["specs"],
+                       pin_ghost=_cov_spec("pin_ghost", "ghost"))
+    f = coverage.audit(REPO, **kw)
+    rules = _rules(f)
+    assert "dead-spec" in rules and "topology-gap" in rules
+    assert any("pin_ghost" in x.message for x in f)
+
+
+def test_coverage_dead_baseline_record():
+    kw = _cov_kwargs()
+    kw["baseline"] = {"families": dict(kw["baseline"]["families"],
+                                       zzz_removed_spec={})}
+    f = coverage.audit(REPO, **kw)
+    assert _rules(f) == ["dead-baseline"]
+    assert "zzz_removed_spec" in f[0].message
+
+
+def test_coverage_donated_drift_both_directions():
+    f = coverage.audit(REPO, **_cov_kwargs(donated=()))
+    assert _rules(f) == ["donated-drift"] and "chained" in f[0].message
+    f = coverage.audit(REPO, **_cov_kwargs(donated=("chained", "ghost")))
+    assert _rules(f) == ["donated-drift"] and "ghost" in f[0].message
+
+
+def test_coverage_run_name_blind_field():
+    kw = _cov_kwargs(program_fields={"bs", "arch"},
+                     run_fields={"arch"})
+    f = coverage.audit(REPO, **kw)
+    assert _rules(f) == ["run-name-blind"] and "'bs'" in f[0].message
+    # an exemption with a reason covers it; stale exemptions are flagged
+    kw["exempt"] = {"bs": "reference vocabulary separates by log_dir"}
+    assert coverage.audit(REPO, **kw) == []
+    kw["exempt"] = {"bs": "reason", "arch": "but run_name reads arch"}
+    f = coverage.audit(REPO, **kw)
+    assert _rules(f) == ["stale-run-name-exemption"]
+
+
+def test_coverage_new_suffix_branch_fails_loudly(tmp_path):
+    """ISSUE-19 acceptance: a new family_suffix branch without a
+    SUFFIX_DRIVERS mapping (so without CheckSpecs either) must fail —
+    the lattice walk cannot enumerate the new slice silently."""
+    cc = tmp_path / contracts.PKG / "utils" / "compile_cache.py"
+    cc.parent.mkdir(parents=True)
+    cc.write_text(textwrap.dedent("""
+        def family_suffix(cfg):
+            sfx = "_async" if is_buffered(cfg) else ""
+            if getattr(cfg, "zigzag", 0):
+                sfx += "_zz"
+            return sfx
+        """))
+    tokens = coverage.suffix_tokens(str(tmp_path))
+    assert tokens == ["_async", "_zz"]
+    f = coverage.audit(REPO, **_cov_kwargs(tokens=tokens))
+    assert _rules(f) == ["suffix-unmapped"] and "_zz" in f[0].message
+    # the reverse direction: a driver for a token the algebra dropped
+    kw = _cov_kwargs(drivers={"_async": {"agg_mode": "buffered"},
+                              "_gone": {"tenants": 9}})
+    f = coverage.audit(REPO, **kw)
+    assert _rules(f) == ["suffix-unmapped"] and "_gone" in f[0].message
+
+
+def test_suffix_tokens_match_driver_table():
+    tokens = coverage.suffix_tokens(REPO)
+    assert tokens == ["_async", "_mb", "_mt"]
+    assert set(tokens) == set(contracts.SUFFIX_DRIVERS)
+
+
+def test_run_name_walk_sees_getattr_and_new_fields():
+    """run_name reads agg_mode/train_layout through getattr helpers
+    (is_buffered, resolved_train_layout) — the walker must see through
+    both; the four fields the coverage pass surfaced as collision bugs
+    must now mark the run dir."""
+    fields = coverage.run_name_fields(REPO)
+    for f in ("agg_mode", "train_layout", "corrupt_mode",
+              "straggler_epochs", "traffic_latency_sigma", "quarantine"):
+        assert f in fields, f
+
+
+def test_repo_coverage_scan_is_clean():
+    """Satellite contract: the reachable lattice is exactly covered —
+    every family pinned or waived with a reason, baseline exactly the
+    live spec x topology matrix, donated set drift-free, every
+    program-provenance field in run_name or exempted with a reason."""
+    findings = coverage.scan_repo(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_coverage_deleted_spec_fails_loudly():
+    """ISSUE-19 acceptance: deleting a CheckSpec whose family has no
+    waiver makes the gate fail (missing-pin) and orphans its committed
+    baseline records (dead-baseline)."""
+    specs = dict(contracts.check_specs())
+    del specs["sharded_rlr_avg_diag"]
+    f = coverage.audit(REPO, specs=specs)
+    assert any(x.rule == "missing-pin" and "round_sharded_diag"
+               in x.message for x in f)
+    assert any(x.rule == "dead-baseline" and "sharded_rlr_avg_diag"
+               in x.message for x in f)
+
+
+def test_write_baseline_prunes_dead_records(tmp_path):
+    live = sorted(coverage.live_baseline_keys(REPO))[0]
+    path = tmp_path / "analysis_baseline.json"
+    path.write_text(json.dumps({"families": {
+        live: {"collectives": {}}, "zzz_dead": {"collectives": {}}}}))
+    # legacy merge keeps unknown records; the prune path drops them
+    jaxpr_lint.write_baseline(str(tmp_path), {"families": {}})
+    fams = json.loads(path.read_text())["families"]
+    assert "zzz_dead" in fams
+    jaxpr_lint.write_baseline(str(tmp_path), {"families": {}}, prune=True)
+    fams = json.loads(path.read_text())["families"]
+    assert live in fams and "zzz_dead" not in fams
+
+
+def test_cli_staged_exit_codes_and_census(monkeypatch, tmp_path):
+    """Exit codes are staged per pass tier (1 legacy, 3 thread,
+    4 coverage) and the census JSON records both."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.analysis.__main__ import (
+        main as cli_main)
+    planted = [ast_rules.Finding("cross-thread-state", "x.py", 1, "p")]
+    monkeypatch.setattr(thread_rules, "scan_repo", lambda root: planted)
+    monkeypatch.setattr(coverage, "scan_repo", lambda root: [])
+    assert cli_main(["--rules", "thread,coverage"]) == 3
+    monkeypatch.setattr(thread_rules, "scan_repo", lambda root: [])
+    monkeypatch.setattr(coverage, "scan_repo", lambda root: planted)
+    census = tmp_path / "census.json"
+    assert cli_main(["--rules", "thread,coverage",
+                     "--census-json", str(census)]) == 4
+    doc = json.loads(census.read_text())
+    assert doc == {"census": {"thread": 0, "coverage": 1},
+                   "exit_code": 4}
+    # legacy findings outrank the newer tiers
+    monkeypatch.setattr(ast_rules, "scan_repo", lambda root: planted)
+    assert cli_main(["--rules", "ast,thread,coverage"]) == 1
+    monkeypatch.setattr(ast_rules, "scan_repo", lambda root: [])
+    monkeypatch.setattr(coverage, "scan_repo", lambda root: [])
+    assert cli_main(["--rules", "thread,coverage"]) == 0
