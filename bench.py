@@ -204,6 +204,17 @@ def main():
                          "headline and ALSO measures the off twin "
                          "(health_ab in the output JSON — the ISSUE-14 "
                          "<=1%% overhead acceptance A/B)")
+    ap.add_argument("--reputation", choices=("auto", "on", "off", "both"),
+                    default="auto",
+                    help="in-program reputation lanes (obs/reputation.py: "
+                         "per-sampled-client rep_agree + rep_norm rows, "
+                         "default auto = on whenever a sign vote exists "
+                         "and the fused Pallas commit is not in use). "
+                         "'off' re-points the headline at the lane-free "
+                         "program; 'both' keeps the auto headline and "
+                         "ALSO measures the off twin (reputation_ab in "
+                         "the output JSON — the ISSUE-20 <1%% overhead "
+                         "acceptance A/B)")
     ap.add_argument("--telemetry", choices=("off", "basic", "full"),
                     default="off",
                     help="also measure rounds/sec with in-jit defense "
@@ -402,6 +413,10 @@ def main():
         # 'off' re-points the headline; 'both' keeps the (default-on)
         # headline and adds the health_ab block below
         extra["health"] = "off"
+    if args.reputation in ("on", "off"):
+        # a single setting re-points the HEADLINE; 'both' keeps the
+        # auto headline and adds the reputation_ab block below
+        extra["reputation"] = args.reputation
     if cpu_fallback:
         extra["data_dir"] = "/nonexistent_use_synthetic_reduced"
     # BASELINE.json configs[1] (fmnist flagship) or configs[3] (resnet9,
@@ -661,6 +676,28 @@ def main():
         log(f"[bench] health-lane overhead: "
             f"{health_ab_out['overhead_pct']}% "
             f"(on {rounds_per_sec:.3f} vs off {r_hoff:.3f} r/s)")
+
+    reputation_ab_out = None
+    if args.reputation == "both":
+        # reputation-lane overhead A/B (ISSUE 20): same config with the
+        # rep_agree + rep_norm client rows compiled OUT of the round
+        # program; the on headline vs the off twin is the cost of the
+        # two lanes (acceptance: <1% on steady rounds/sec — both rows
+        # are device-local reductions riding the existing sign-sum tree
+        # and update buffers, so there is no collective delta to pay)
+        hb.update(phase="reputation_ab", force=True)
+        _, r_roff, c_roff, _ = measure(cfg.replace(reputation="off"),
+                                       label="[reputation off]")
+        reputation_ab_out = {
+            "on_rounds_per_sec": round(rounds_per_sec, 4),
+            "off_rounds_per_sec": round(r_roff, 4),
+            "overhead_pct": round(
+                100.0 * (1.0 - rounds_per_sec / r_roff), 2),
+            "compile_s_off": round(c_roff, 1),
+        }
+        log(f"[bench] reputation-lane overhead: "
+            f"{reputation_ab_out['overhead_pct']}% "
+            f"(on {rounds_per_sec:.3f} vs off {r_roff:.3f} r/s)")
 
     events_ab_out = None
     if args.events == "both":
@@ -1238,6 +1275,9 @@ def main():
     out["health"] = cfg.health
     if health_ab_out is not None:
         out["health_ab"] = health_ab_out
+    out["reputation"] = cfg.reputation
+    if reputation_ab_out is not None:
+        out["reputation_ab"] = reputation_ab_out
     if events_ab_out is not None:
         out["events_ab"] = events_ab_out
     if population_out is not None:

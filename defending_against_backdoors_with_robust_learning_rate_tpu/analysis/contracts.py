@@ -58,6 +58,11 @@ HOT_PATH_MODULES = (
     # round program (the host-side half lives in health/monitor.py,
     # which is deliberately NOT hot-path scope)
     f"{PKG}/health/sentinel.py",
+    # in-jit reputation lane (ISSUE 20): agree_rows/agree_rows_flat are
+    # traced into every round program; the module's host half
+    # (ReputationTracker) runs on the post-drain emit path and carries
+    # ALLOW entries below
+    f"{PKG}/obs/reputation.py",
 )
 
 # Function-level exemptions: (repo-relative path, function qualname prefix)
@@ -79,6 +84,31 @@ ALLOW: Dict[Tuple[str, str], Dict[str, str]] = {
         "host-sync": "summary/adaptation snapshot builder on the same "
                      "post-drain host path as emit_scalars; called only "
                      "with already-fetched values",
+    },
+    # ReputationTracker methods (the AST pass keys bare method qualnames
+    # — class names do not prefix): the longitudinal tracker folds
+    # DRAINED rows on the post-drain emit path (train.py _emit_eval_body
+    # / service tenancy _emit_slot); every value it touches is already
+    # host-side
+    (f"{PKG}/obs/reputation.py", "fold"): {
+        "host-sync": "ReputationTracker.fold consumes drained numpy rows "
+                     "on the post-drain emit path; values are already "
+                     "host-side",
+    },
+    (f"{PKG}/obs/reputation.py", "boundary_rows"): {
+        "host-sync": "ReputationTracker.boundary_rows renders host-side "
+                     "Python EMA state into metrics rows on the emit "
+                     "path; no device value is touched",
+    },
+    (f"{PKG}/obs/reputation.py", "load_state"): {
+        "host-sync": "ReputationTracker.load_state converts JSON journal "
+                     "scalars at resume time; no device value is touched",
+    },
+    (f"{PKG}/obs/reputation.py", "emit_rows"): {
+        "host-sync": "host emit path shared by the sync/async metrics "
+                     "streams and the tenant fan-out (the emit_scalars "
+                     "discipline); called only with already-folded "
+                     "host state",
     },
     (f"{PKG}/fl/diagnostics.py", "norm_scalars"): {
         "host-sync": "snap-cadence research diagnostics; --diagnostics "
@@ -817,6 +847,65 @@ def collective_budgets(n_leaves: int) -> Dict[str, "CheckSpec"]:
         collective_budget={**zero, "psum": 2 * n_leaves + 2},
         hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
 
+    # in-jit reputation lane (ISSUE 20, obs/reputation.py): per-sampled-
+    # client sign-agreement vs the committed vote. The acceptance claim
+    # is ZERO added collectives on every dispatch surface at 1/8/16-way:
+    # the vmap/megabatch/tenant paths compute rep_agree as collective-
+    # free [m]/[E,m] reductions, the sharded leaf paths re-read the
+    # vote's existing sign-sum psums and stitch the sharded [m/d] row
+    # through the P(AGENTS_AXIS) out_spec, the bucketed layout rides the
+    # sign shard on its existing result all_gather (a widened payload,
+    # never a new collective), and the buffered fold compares against
+    # the replicated vote the commit already holds. Every `*_rep` twin
+    # therefore pins its plain counterpart's budget UNCHANGED; the
+    # `_off` twin pins that the A/B arm really removes the lane.
+    rep = {"reputation": "on"}
+    specs["vmap_rlr_avg_rep"] = CheckSpec(
+        name="vmap_rlr_avg_rep", family="round", sharded=False,
+        cfg_overrides=dict(rep), collective_budget=dict(zero))
+    specs["vmap_rlr_avg_rep_off"] = CheckSpec(
+        name="vmap_rlr_avg_rep_off", family="round", sharded=False,
+        cfg_overrides={"reputation": "off"},
+        collective_budget=dict(zero))
+    specs["sharded_rlr_avg_rep"] = CheckSpec(
+        name="sharded_rlr_avg_rep", family="round_sharded",
+        sharded=True, cfg_overrides=dict(rep),
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_sign_rep"] = CheckSpec(
+        name="sharded_rlr_sign_rep", family="round_sharded",
+        sharded=True,
+        cfg_overrides={**rep, "aggr": "sign", "server_lr": 1.0},
+        collective_budget={**zero, "psum": n_leaves + 1},
+        hlo_all_reduce_max=n_leaves + 1 + spmd_overhead)
+    specs["sharded_rlr_avg_bucket_rep"] = CheckSpec(
+        name="sharded_rlr_avg_bucket_rep", family="round_sharded",
+        sharded=True, cfg_overrides={**rep, "agg_layout": "bucket"},
+        collective_budget=dict(rs_budget),
+        hlo_all_reduce_max=2 + spmd_overhead)
+    specs["sharded_rlr_avg_cohort_rep"] = CheckSpec(
+        name="sharded_rlr_avg_cohort_rep",
+        family="round_sharded_cohort", sharded=True,
+        cfg_overrides={**rep, "cohort_sampled": "on"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_mb_rep"] = CheckSpec(
+        name="sharded_rlr_avg_mb_rep", family="round_sharded_mb",
+        sharded=True,
+        cfg_overrides={**rep, "train_layout": "megabatch"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_async_rep"] = CheckSpec(
+        name="sharded_rlr_avg_async_rep", family="round_sharded_async",
+        sharded=True, cfg_overrides={**rep, "agg_mode": "buffered"},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+    specs["sharded_rlr_avg_mt_rep"] = CheckSpec(
+        name="sharded_rlr_avg_mt_rep", family="round_sharded_mt",
+        sharded=True, cfg_overrides={**rep, "tenants": 2},
+        collective_budget={**zero, "psum": 2 * n_leaves + 2},
+        hlo_all_reduce_max=2 * n_leaves + 2 + spmd_overhead)
+
     # lattice cross-terms the coverage pass (analysis/coverage.py)
     # surfaced as reachable-but-unpinned: the suffix algebra composes
     # (_async x _mb x _mt, each mechanism individually pinned above),
@@ -1033,4 +1122,10 @@ RUN_NAME_EXEMPT: Dict[str, str] = {
         "land under each tenant's OWN run_name (service/tenancy), and "
         "pack-vs-standalone parity is the acceptance contract — the "
         "same cell must resolve to the same dir either way"),
+    "reputation": (
+        "the in-jit agreement lane only ADDS monitoring reductions; the "
+        "update math is untouched (--reputation off bit-identity is a "
+        "tier-1 pin, the health precedent) — the lane is observability, "
+        "not experiment identity, and the tracker it feeds is "
+        "observe-only by contract"),
 }

@@ -315,6 +315,18 @@ class Config:
                                     # an incident
     health_spike_factor: float = 10.0  # update-norm spike trigger: norm >
                                     # factor x its EMA baseline
+    defense_flip_frac_hi: float = 0.5  # Defense/Flip_Fraction above which
+                                    # a boundary counts as a defense
+                                    # anomaly (health/monitor.py). The
+                                    # default is the PR-15 heuristic;
+                                    # calibrate it from the reputation
+                                    # plane's measured flip quantiles
+                                    # (README "Defense observability")
+    defense_low_margin_hi: float = 0.25  # low-vote-margin mass above which
+                                    # a boundary counts as a defense
+                                    # anomaly; same calibration source
+                                    # (Reputation/* quantiles) as
+                                    # defense_flip_frac_hi
     quarantine: str = ""            # comma-separated client ids excluded
                                     # from every round's participation
                                     # mask (the ladder's QUARANTINE rung
@@ -366,6 +378,39 @@ class Config:
                                     # honest/corrupt cosine split (full).
                                     # off adds NOTHING to the traced
                                     # program: training is bit-identical.
+    reputation: str = "auto"        # auto | on | off — the per-client
+                                    # defense-provenance lanes
+                                    # (obs/reputation.py): every round the
+                                    # traced program additionally emits
+                                    # per-sampled-client rep_agree
+                                    # (fraction of parameter coordinates
+                                    # whose update sign matches the
+                                    # committed sign vote) and rep_norm
+                                    # (update L2 — the magnitude signal
+                                    # the sign vote cannot carry) scalars,
+                                    # mask-aware,
+                                    # with ZERO added collectives, folded
+                                    # host-side into a longitudinal
+                                    # per-client suspicion ledger
+                                    # (Reputation/* rows, rep/* events).
+                                    # auto = on whenever a sign vote
+                                    # exists (robustLR_threshold > 0 or
+                                    # aggr='sign') and the fused Pallas
+                                    # server step is not in use; off
+                                    # removes the lane — training and
+                                    # every metrics surface bit-identical
+    rep_population_cap: int = 100000  # dense per-client dict up to this
+                                    # population; above it the tracker
+                                    # switches to a count-min sketch +
+                                    # top-k heavy-hitter ledger so RSS
+                                    # stays O(cohort + k) at 10M clients
+    rep_topk: int = 64              # heavy-hitter ledger width (ranked
+                                    # suspects surfaced per boundary)
+    rep_streak: int = 3             # consecutive vote-losing boundaries
+                                    # before a client crosses the
+                                    # suspicion threshold (rep/suspect
+                                    # ledger event; observe-only — the
+                                    # health ladder owns quarantine)
     spans: bool = True              # host-side round-trace spans
                                     # (obs/spans.py): trace.json in the run
                                     # dir + Spans/* aggregates in
@@ -643,6 +688,11 @@ FIELD_PROVENANCE = {
                                    # read in a trace
     "health_z_threshold": "runtime",   # host-side EMA judgement knobs
     "health_spike_factor": "runtime",  # (health/monitor.py)
+    "defense_flip_frac_hi": "runtime",   # host-side defense-anomaly
+    "defense_low_margin_hi": "runtime",  # judgement thresholds
+                                         # (health/monitor.py), calibrated
+                                         # from Reputation/* quantiles —
+                                         # never read in a trace
     "quarantine": "program",       # the quarantined-id set is a traced
                                    # membership constant (the churn_seed
                                    # idiom: baked in, keys the cache)
@@ -657,6 +707,14 @@ FIELD_PROVENANCE = {
     "compile_cache_dir": "runtime",
     "async_metrics": "runtime",
     "telemetry": "program",       # adds outputs to the traced program
+    "reputation": "program",      # the per-client agreement lane adds
+                                  # outputs to (and rides the existing
+                                  # reductions of) the traced round
+                                  # program — a program difference like
+                                  # telemetry/health
+    "rep_population_cap": "runtime",  # host-side tracker representation
+    "rep_topk": "runtime",            # knobs (obs/reputation.py) — never
+    "rep_streak": "runtime",          # read in a trace
     "spans": "runtime",
     "heartbeat": "runtime",
     "status_file": "runtime",
@@ -998,6 +1056,37 @@ def _add_tpu_flags(p: argparse.ArgumentParser) -> None:
                    default=d.health_spike_factor,
                    help="update-norm spike trigger: norm > factor x its "
                         "EMA baseline")
+    p.add_argument("--defense_flip_frac_hi", type=float,
+                   default=d.defense_flip_frac_hi,
+                   help="Defense/Flip_Fraction above which a boundary is "
+                        "a defense anomaly (health/monitor.py); calibrate "
+                        "from the reputation plane's measured quantiles")
+    p.add_argument("--defense_low_margin_hi", type=float,
+                   default=d.defense_low_margin_hi,
+                   help="low-vote-margin mass above which a boundary is a "
+                        "defense anomaly; same Reputation/* calibration "
+                        "source as --defense_flip_frac_hi")
+    p.add_argument("--reputation", choices=("auto", "on", "off"),
+                   default=d.reputation,
+                   help="per-client defense-provenance lanes "
+                        "(obs/reputation.py): rep_agree + rep_norm per "
+                        "sampled client with zero added collectives, "
+                        "folded into a longitudinal suspicion ledger "
+                        "(Reputation/* rows, rep/* events). auto = on "
+                        "when a sign vote exists and pallas is off; off "
+                        "is bit-identical")
+    p.add_argument("--rep_population_cap", type=int,
+                   default=d.rep_population_cap,
+                   help="population above which the reputation tracker "
+                        "switches from a dense per-client dict to a "
+                        "count-min sketch + top-k heavy-hitter ledger")
+    p.add_argument("--rep_topk", type=int, default=d.rep_topk,
+                   help="reputation heavy-hitter ledger width (ranked "
+                        "suspects surfaced per eval boundary)")
+    p.add_argument("--rep_streak", type=int, default=d.rep_streak,
+                   help="consecutive vote-losing boundaries before a "
+                        "client crosses the suspicion threshold "
+                        "(rep/suspect event; observe-only)")
     p.add_argument("--quarantine", type=str, default=d.quarantine,
                    help="comma-separated client ids excluded from every "
                         "round's participation mask (the recovery "

@@ -75,7 +75,12 @@ def _pallas_applicable(cfg) -> bool:
             and not buffered.is_buffered(cfg)
             and cfg.tenants == 0
             and not health_sentinel.has_quarantine(cfg)
-            and cfg.telemetry == "off")
+            and cfg.telemetry == "off"
+            # the reputation lane (obs/reputation.py) reads the explicit
+            # sign-sum tree the fused kernel never materializes — an
+            # EXPLICIT --reputation on falls back like telemetry ("auto"
+            # instead resolves the lane off and keeps the kernel)
+            and cfg.reputation != "on")
 
 
 def host_takes_flags(cfg) -> bool:
@@ -336,6 +341,20 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
                 agg, mask=mask, corrupt_flags=corrupt_flags,
                 sign_sums=vote_sign,
                 vote_range=buffered.vote_range(cfg)))
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            reputation as rep_mod)
+        if rep_mod.reputation_on(cfg):
+            # agreement vs the BUFFER's accumulated sign vote (the
+            # electorate the commit decision actually thresholds) —
+            # elementwise vs the replicated vote_sign tree, zero
+            # collectives
+            from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+                masking)
+            u_rep = (updates if mask is None
+                     else masking.zero_masked(updates, mask))
+            extras["rep_agree"] = rep_mod.agree_rows(u_rep, vote_sign,
+                                                     mask=mask)
+            extras["rep_norm"] = rep_mod.norm_rows(u_rep, mask=mask)
         if health_sentinel.health_on(cfg):
             with jax.named_scope("health"):
                 extras.update(health_sentinel.sentinel(
@@ -379,6 +398,22 @@ def _round_core(params, k_train, k_noise, imgs, lbls, sizes, *,
         extras.update(telemetry.compute(
             cfg, updates, lr if cfg.robustLR_threshold > 0 else None, agg,
             mask=mask, corrupt_flags=corrupt_flags))
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        reputation as rep_mod)
+    if rep_mod.reputation_on(cfg):
+        # per-client agreement vs the committed sign vote: derived from
+        # the SAME zero-masked updates the vote counted, so the
+        # electorate matches robust_lr's — elementwise reductions only,
+        # zero collectives (the *_rep CheckSpec pins)
+        if mask is not None:
+            from defending_against_backdoors_with_robust_learning_rate_tpu.faults import (
+                masking)
+            u_rep = masking.zero_masked(updates, mask)
+        else:
+            u_rep = updates
+        extras["rep_agree"] = rep_mod.agree_rows(
+            u_rep, rep_mod.sign_sums_from(u_rep), mask=mask)
+        extras["rep_norm"] = rep_mod.norm_rows(u_rep, mask=mask)
     if cfg.diagnostics:
         from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
             per_agent_norms)
@@ -418,10 +453,11 @@ def make_chained(step, data, family: str = "chained"):
             out = {"train_loss": info["train_loss"],
                    "sampled": info["sampled"]}
             out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
-            # telemetry — and health-sentinel — scalars ride the scan
-            # stacked per-round, like the fault counters
+            # telemetry, health-sentinel and reputation ([m] rep_agree)
+            # values ride the scan stacked per-round, like the fault
+            # counters
             out.update({k: v for k, v in info.items()
-                        if k.startswith(("tel_", "hlth_"))})
+                        if k.startswith(("tel_", "hlth_", "rep_"))})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short
@@ -694,7 +730,7 @@ def make_chained_host(step):
             out = {"train_loss": info["train_loss"]}
             out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
             out.update({k: v for k, v in info.items()
-                        if k.startswith(("tel_", "hlth_"))})
+                        if k.startswith(("tel_", "hlth_", "rep_"))})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short chains

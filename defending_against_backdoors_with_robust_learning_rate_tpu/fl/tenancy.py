@@ -292,7 +292,7 @@ def make_tenant_chained_fn(cfg, model, normalize, images, labels, sizes):
                    "sampled": info["sampled"]}
             out.update({k: info[k] for k in CHAINED_INFO_KEYS if k in info})
             out.update({k: v for k, v in info.items()
-                        if k.startswith(("tel_", "hlth_"))})
+                        if k.startswith(("tel_", "hlth_", "rep_"))})
             return new_params, out
 
         # XLA:CPU conv-in-while slow path (ops/loops.py): unroll short

@@ -221,17 +221,29 @@ def enforce(cfg, report, where: str = "") -> bool:
     return report["healthy"]
 
 
-# defense-telemetry anomaly thresholds (ROADMAP PR-14 follow-up): the
-# same signatures the adaptation policy acts on (attack/adapt.py), here
-# only OBSERVED — a low-severity ledger event, never a ladder trigger.
+# defense-telemetry anomaly threshold DEFAULTS (ROADMAP PR-14
+# follow-up): the same signatures the adaptation policy acts on
+# (attack/adapt.py), here only OBSERVED — a low-severity ledger event,
+# never a ladder trigger. The operative values live in config
+# (``defense_flip_frac_hi`` / ``defense_low_margin_hi``,
+# FIELD_PROVENANCE-tagged); these module constants are the argparse
+# defaults' mirror so bare callers (tests) get the shipped calibration.
 DEFENSE_FLIP_FRAC_HI = 0.5      # defense reversing most coordinates
 DEFENSE_LOW_MARGIN_HI = 0.25    # electorate-splitting histogram mass
 
 
-def defense_anomaly(defense: Optional[Dict]) -> str:
+def defense_anomaly(defense: Optional[Dict],
+                    flip_hi: Optional[float] = None,
+                    low_margin_hi: Optional[float] = None) -> str:
     """Judge one boundary's drained Defense/* summary
     (obs/telemetry.host_summary) for the defense-side anomaly
     signatures; returns the reason string ('' = nothing anomalous).
+
+    Thresholds default to the shipped calibration above; the service
+    driver passes the config fields (``defense_flip_frac_hi`` /
+    ``defense_low_margin_hi``) so deployments can recalibrate from the
+    reputation plane's measured agreement quantiles without a code
+    change (config.FIELD_PROVENANCE documents the derivation).
 
     Deliberately decoupled from ``assess``: a defense anomaly is the
     MECHANISM misbehaving (over-flipping, a splitting electorate), not
@@ -239,21 +251,24 @@ def defense_anomaly(defense: Optional[Dict]) -> str:
     numerics incidents (the service driver emits it as a LOW-severity
     ``health/defense_anomaly`` ledger record) without ever feeding the
     recovery ladder."""
+    flip_hi = DEFENSE_FLIP_FRAC_HI if flip_hi is None else flip_hi
+    low_margin_hi = (DEFENSE_LOW_MARGIN_HI if low_margin_hi is None
+                     else low_margin_hi)
     if not defense or "tel_flip_frac" not in defense:
         return ""
     why = []
     flip = float(defense["tel_flip_frac"])
-    if flip >= DEFENSE_FLIP_FRAC_HI:
-        why.append(f"flip fraction {flip:.2f} >= {DEFENSE_FLIP_FRAC_HI} "
+    if flip >= flip_hi:
+        why.append(f"flip fraction {flip:.2f} >= {flip_hi} "
                    f"(defense reversing most coordinates)")
     hist = defense.get("tel_margin_hist")
     if hist:
         from defending_against_backdoors_with_robust_learning_rate_tpu.attack.adapt import (
             low_margin_mass)
         mass = low_margin_mass(hist)
-        if mass >= DEFENSE_LOW_MARGIN_HI:
+        if mass >= low_margin_hi:
             why.append(f"low-margin vote mass {mass:.2f} >= "
-                       f"{DEFENSE_LOW_MARGIN_HI} (electorate splitting)")
+                       f"{low_margin_hi} (electorate splitting)")
     return "; ".join(why)
 
 

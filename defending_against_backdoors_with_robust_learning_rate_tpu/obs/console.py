@@ -42,10 +42,10 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     heartbeat as hb_mod)
 
 TAIL_BYTES = 1 << 16
-COLUMNS = ("run", "phase", "round", "rps", "val_acc", "ledger_seq",
-           "last_event", "incident", "warn_err", "age")
-HEADERS = ("RUN", "PHASE", "ROUND", "R/S", "VAL", "SEQ", "LAST EVENT",
-           "INCIDENT", "W/E", "AGE")
+COLUMNS = ("run", "phase", "round", "rps", "val_acc", "suspects",
+           "ledger_seq", "last_event", "incident", "warn_err", "age")
+HEADERS = ("RUN", "PHASE", "ROUND", "R/S", "VAL", "SUSPECTS", "SEQ",
+           "LAST EVENT", "INCIDENT", "W/E", "AGE")
 
 
 def _tail_lines(path: str, max_bytes: int = TAIL_BYTES) -> List[str]:
@@ -161,6 +161,11 @@ def scan_fleet(log_root: str, now: Optional[float] = None
             "age_s": (now - updated) if updated else None,
             "rps": _last_metric(metrics, "Throughput/Rounds_Per_Sec"),
             "val_acc": _last_metric(metrics, "Validation/Accuracy"),
+            # defense-provenance column (obs/reputation.py): how many
+            # clients this run's suspicion ledger has past the streak
+            # threshold — None (rendered "—") when the lane is off
+            "suspects": _last_metric(metrics,
+                                     "Reputation/Suspect_Count"),
             "ledger_seq": ledger_seq,
             "last_event": last_event,
             "last_incident": last_incident,
@@ -207,6 +212,8 @@ def _cells(row: Dict[str, Any]) -> List[str]:
         rnd,
         "—" if row.get("rps") is None else f"{row['rps']:.3f}",
         "—" if row.get("val_acc") is None else f"{row['val_acc']:.3f}",
+        ("—" if row.get("suspects") is None
+         else str(int(row["suspects"]))),
         "—" if row.get("ledger_seq") is None else str(row["ledger_seq"]),
         ev,
         incident,

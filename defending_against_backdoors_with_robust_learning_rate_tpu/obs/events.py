@@ -66,6 +66,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -76,7 +77,15 @@ SEVERITIES = ("info", "warn", "error")
 # events a crash-exact replay legitimately re-performs: deduped by a
 # monotone per-event round high-water mark (rounds only move forward
 # past the resume point, so a scalar mark suffices)
-REPLAY_DEDUPE_EVENTS = ("checkpoint/save", "health/defense_anomaly")
+REPLAY_DEDUPE_EVENTS = ("checkpoint/save", "health/defense_anomaly",
+                        "rep/suspect")
+
+# replay-deduped events whose high-water mark is PER SUBJECT, not per
+# event name: rep/suspect announces one client each — two clients
+# crossing at the same round are distinct records, while a crash-exact
+# replay re-crossing the SAME client at the same round is the duplicate
+# the mark exists to suppress (tenant scopes packed cells' id spaces)
+REPLAY_DEDUPE_FIELDS = {"rep/suspect": ("tenant", "client")}
 
 # records that document one PROCESS LIFE's real actions rather than the
 # run's logical history: an interrupted-and-resumed run has more of them
@@ -90,6 +99,16 @@ WALLCLOCK_FIELDS = ("t",)
 
 # the SIGKILL chaos family is never ledgered (see module docstring)
 _UNLEDGERED_CHAOS = ("kill", "kill_midbuf", "kill_recover")
+
+
+def _dedupe_key(event: str, fields: Dict[str, Any]) -> str:
+    """The replay-dedupe map key: the event name, extended with the
+    event's subject fields (REPLAY_DEDUPE_FIELDS) when it announces a
+    per-subject fact rather than a per-round one."""
+    subs = REPLAY_DEDUPE_FIELDS.get(event)
+    if not subs:
+        return event
+    return event + ":" + ":".join(str(fields.get(f)) for f in subs)
 
 
 def corr_id(name: str) -> str:
@@ -119,6 +138,11 @@ class EventLedger:
         self._f = None
         self.seq = 0
         self._dedupe_hw: Dict[str, int] = {}
+        # emit() is called from the driver thread AND the MetricsDrain
+        # worker (the reputation plane's rep/suspect events ride the
+        # drain-side emit body while checkpoint/save lands driver-side):
+        # the seq counter, dedupe marks and file handle serialize here
+        self._lock = threading.Lock()
         self.enabled = bool(path)
         if not self.enabled:
             return
@@ -135,10 +159,11 @@ class EventLedger:
         """Truncate a torn tail back to the last complete, parseable
         line; resume the seq numbering and rebuild the replay-dedupe
         high-water marks from the surviving records."""
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as f:
-            data = f.read()
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return   # fresh ledger — nothing to recover
         good_end = 0
         for line in data.splitlines(keepends=True):
             if not line.endswith(b"\n"):
@@ -151,8 +176,9 @@ class EventLedger:
             event = rec.get("event")
             rnd = rec.get("round")
             if event in REPLAY_DEDUPE_EVENTS and isinstance(rnd, int):
-                self._dedupe_hw[event] = max(
-                    self._dedupe_hw.get(event, -1), rnd)
+                key = _dedupe_key(event, rec)
+                self._dedupe_hw[key] = max(
+                    self._dedupe_hw.get(key, -1), rnd)
             good_end += len(line)
         if good_end < len(data):
             with open(self.path, "r+b") as f:
@@ -167,41 +193,49 @@ class EventLedger:
         ledger is disabled). Field order is fixed (schema head, then
         sorted extras) so identical event sequences produce identical
         bytes modulo the ``t`` stamp."""
-        if not self.enabled:
-            return None
         if severity not in SEVERITIES:
             raise ValueError(f"severity must be one of {SEVERITIES}, "
                              f"got {severity!r}")
-        if event in REPLAY_DEDUPE_EVENTS and round is not None:
-            if round <= self._dedupe_hw.get(event, -1):
-                return None   # a crash-exact replay re-performing the act
-            self._dedupe_hw[event] = round
-        rec: Dict[str, Any] = {
-            "seq": self.seq, "event": event, "severity": severity,
-            "run": self.run, "corr": self.corr, "round": round,
-            "t": self._clock(),
-        }
-        for key in sorted(fields):
-            rec[key] = fields[key]
-        try:
-            self._f.write((json.dumps(rec) + "\n").encode())
-            self._f.flush()
-        except (OSError, ValueError):
-            self.enabled = False   # observability never takes down the run
-            return None
-        self.seq += 1
+        with self._lock:
+            # checked under the lock: a concurrent write failure (or
+            # close) may have disabled the ledger since the caller's view
+            if not self.enabled:
+                return None
+            if event in REPLAY_DEDUPE_EVENTS and round is not None:
+                key = _dedupe_key(event, fields)
+                if round <= self._dedupe_hw.get(key, -1):
+                    return None   # crash-exact replay re-performing the act
+                self._dedupe_hw[key] = round
+            rec: Dict[str, Any] = {
+                "seq": self.seq, "event": event, "severity": severity,
+                "run": self.run, "corr": self.corr, "round": round,
+                "t": self._clock(),
+            }
+            for key in sorted(fields):
+                rec[key] = fields[key]
+            try:
+                self._f.write((json.dumps(rec) + "\n").encode())
+                self._f.flush()
+            except (OSError, ValueError):
+                self.enabled = False   # observability never takes down a run
+                return None
+            self.seq += 1
+        # the heartbeat hook runs OUTSIDE the critical section: it does
+        # its own IO (status.json) and must not serialize against — or
+        # deadlock by re-entering — the emit path
         if self.on_emit is not None:
             self.on_emit(rec)
         return rec
 
     def close(self) -> None:
-        if self._f is not None:
-            try:
-                self._f.close()
-            except OSError:
-                pass
-            self._f = None
-        self.enabled = False
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            self.enabled = False
 
 
 # --------------------------------------------------------------------------

@@ -251,6 +251,35 @@ def render_markdown(doc: Dict[str, Any]) -> str:
                 f"| {100 * v['collective_frac']:.1f} |")
     add("")
 
+    rep = doc.get("reputation", {})
+    if rep:
+        # defense-provenance section (obs/reputation.py): present only
+        # when the run emitted Reputation/* rows — an off run's report
+        # is byte-identical to the pre-plane format
+        add("## Defense provenance")
+        add("")
+        add(f"- clients tracked: {_fmt(rep.get('Reputation/Clients_Tracked'), 0)}")
+        add(f"- suspects past streak threshold: "
+            f"{_fmt(rep.get('Reputation/Suspect_Count'), 0)}")
+        add(f"- agreement (mean / min over sampled): "
+            f"{_fmt(rep.get('Reputation/Mean_Agree'))} / "
+            f"{_fmt(rep.get('Reputation/Min_Agree'))}")
+        if "Reputation/Top_Suspect_Score" in rep:
+            add(f"- top suspicion score: "
+                f"{_fmt(rep['Reputation/Top_Suspect_Score'])}")
+        if "Reputation/Suspicion_AUC" in rep:
+            add(f"- suspicion ranking AUC vs known corrupt ids: "
+                f"{_fmt(rep['Reputation/Suspicion_AUC'])}")
+        tops = sorted((t, v) for t, v in rep.items()
+                      if t.startswith("Reputation/Top_Suspects/"))
+        if tops:
+            add("")
+            add("| rank | client id |")
+            add("|---:|---:|")
+            for t, v in tops:
+                add(f"| {t.rsplit('/', 1)[1]} | {int(v)} |")
+        add("")
+
     add("## Memory")
     add("")
     mem = doc.get("memory", {})
@@ -328,6 +357,10 @@ def generate(run_dir: str, trace_dir: Optional[str] = None,
         "attribution": attr,
         "memory": {t: v for t, v in metrics.items()
                    if t.startswith("Memory/")},
+        # defense-provenance rows (obs/reputation.py) — empty (and the
+        # report section absent) when the run had --reputation off
+        "reputation": {t: v for t, v in metrics.items()
+                       if t.startswith("Reputation/")},
         "metrics": metrics,
     }
     bl = load_baseline(baseline_path

@@ -75,7 +75,7 @@ def _from_param_shard(chunk, L, leaf_shape):
 
 
 def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
-                       mask_full=None):
+                       mask_full=None, out=None):
     """Aggregation rules as collectives. `updates` leaves are the local block
     [m/d, ...]; `d` is the mesh size; returns the replicated aggregate.
 
@@ -84,7 +84,13 @@ def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
     before the psums, and `mask_full` ([m] bool, replicated — every device
     derives the identical draw from the replicated fault key) drives the
     sentinel/index arithmetic on the all_to_all-transposed [m, c] chunks.
-    None/None is the dense path, bit-for-bit the pre-faults behavior."""
+    None/None is the dense path, bit-for-bit the pre-faults behavior.
+
+    `out` (optional dict): the sign branch stashes its raw per-leaf
+    sign-sum psum results under ``"sign_sums"`` — the reputation lane
+    (obs/reputation.py) re-reads the existing collective instead of
+    issuing its own (the `_sharded_sign_shared` sharing discipline for
+    the thresholdless sign aggregate)."""
     ax = AGENTS_AXIS
     masked = mask_local is not None
     if masked:
@@ -107,9 +113,12 @@ def _sharded_aggregate(updates, sizes, cfg, d, key, mask_local=None,
         if masked:
             # zeroed rows vote sign(0) = 0 in the psum
             updates = masking.zero_masked(updates, mask_local)
-        agg = tree.map(
-            lambda u: jnp.sign(jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), ax)),
+        sums = tree.map(
+            lambda u: jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), ax),
             updates)
+        if out is not None:
+            out["sign_sums"] = sums
+        agg = tree.map(jnp.sign, sums)
     elif cfg.aggr == "comed":
         m = cfg.agents_per_round
 
@@ -255,12 +264,15 @@ def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None,
     """RLR sign-agreement vote as a psum (src/aggregation.py:48-54 semantics,
     vote over exactly the m sampled agents — minus masked-out voters on the
     faults path, where the threshold may also scale with the electorate).
-    Returns (lr_tree, abs_sign_sums_tree): the |psum| the vote thresholds
-    is also exactly the margin full telemetry histograms, so handing it
-    out keeps telemetry's collective count at zero extra psums (the same
-    sharing `_sharded_sign_shared` does for the sign aggregate).
-    `knobs` overrides the threshold/server-lr constants per tenant
-    (fl/tenancy.py)."""
+    Returns (lr_tree, sign_sums_tree): the RAW signed per-leaf psums —
+    `rlr_from_sign_sum` takes |s| internally and full telemetry's margin
+    histogram takes |s| at the read site, so handing the raw sums out is
+    value-identical to the historical |psum| hand-off while ALSO carrying
+    the vote's direction, which the reputation lane (obs/reputation.py)
+    compares per-client updates against. Zero extra psums either way
+    (the same sharing `_sharded_sign_shared` does for the sign
+    aggregate). `knobs` overrides the threshold/server-lr constants per
+    tenant (fl/tenancy.py)."""
     thr = (float(cfg.robustLR_threshold) if knobs is None
            else knobs.rlr_threshold)
     if mask_local is not None:
@@ -274,7 +286,7 @@ def _sharded_robust_lr(updates, cfg, mask_local=None, mask_full=None,
     leaves, treedef = jax.tree_util.tree_flatten(updates)
     lr_leaves, s_leaves = [], []
     for u in leaves:
-        s = jnp.abs(jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS))
+        s = jax.lax.psum(jnp.sum(jnp.sign(u), axis=0), AGENTS_AXIS)
         lr_leaves.append(rlr_from_sign_sum(s, thr, slr))
         s_leaves.append(s)
     return (jax.tree_util.tree_unflatten(treedef, lr_leaves),
@@ -298,13 +310,19 @@ class _BucketInfo:
     aggregate tree (full level only — reassembled from the same
     all_gather that carried the LR-scaled result), the globally-summed
     vote/flip stats vector that rode that gather (obs/telemetry.py
-    shard_vote_stats; None when telemetry is off), and the real (unpadded)
-    coordinate count."""
+    shard_vote_stats; None when telemetry is off), the real (unpadded)
+    coordinate count, and — when the reputation lane is on — this
+    device's [m/d] rep_agree block (obs/reputation.py, computed against
+    the full sign vote whose shard rode the same gather) plus its [m/d]
+    rep_norm block (local: the flat block holds full coordinate rows)."""
 
-    def __init__(self, agg=None, stats=None, total_coords=0):
+    def __init__(self, agg=None, stats=None, total_coords=0,
+                 rep_agree=None, rep_norm=None):
         self.agg = agg
         self.stats = stats
         self.total_coords = total_coords
+        self.rep_agree = rep_agree
+        self.rep_norm = rep_norm
 
 
 def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
@@ -394,10 +412,19 @@ def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
     # the replicated agg tree) and the tiny vote/flip stats vector
     # (basic/full: summed across devices after the gather), so telemetry
     # adds ZERO collectives here
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+        reputation as rep_mod)
+    rep_on = rep_mod.reputation_on(cfg)
     payload = [delta_s]
     stats_len = 0
     if cfg.telemetry == "full":
         payload.append(agg_s)
+    if rep_on:
+        # the reputation lane needs the FULL signed vote replicated to
+        # compare each local client block against — the sign-sum shard
+        # rides the SAME result all_gather (a widened payload, never a
+        # new collective; the *_rep CheckSpecs pin the unchanged plan)
+        payload.append(sign_s)
     if cfg.telemetry != "off":
         from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
             telemetry)
@@ -424,6 +451,16 @@ def _bucketed_apply(params, updates, sizes, cfg, noise_key, d,
         info.agg = buckets.unflatten(
             layout, buckets.gathered_to_flat(layout, gathered[:, dl:2 * dl]),
             treedef)
+    if rep_on:
+        off = dl * (2 if cfg.telemetry == "full" else 1)
+        sign_full = buckets.gathered_to_flat(layout,
+                                             gathered[:, off:off + dl])
+        real_full = jnp.arange(sign_full.shape[0]) < layout.total
+        info.rep_agree = rep_mod.agree_rows_flat(flat, sign_full,
+                                                 real_full, layout.total)
+        # norm is local: flat's padding coordinates are explicit zeros,
+        # so the row L2 over the padded block equals the real-coord norm
+        info.rep_norm = rep_mod.norm_rows(flat)
     if stats_len:
         info.stats = jnp.sum(gathered[:, -stats_len:], axis=0)
     return new_params, info
@@ -797,6 +834,17 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                     mask_full=mask_full, corrupt_full=corrupt_full,
                     sign_sums=vote_sign,
                     vote_range=buffered.vote_range(cfg)))
+            from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+                reputation as rep_mod)
+            if rep_mod.reputation_on(cfg):
+                # agreement vs the BUFFER's replicated accumulated sign
+                # vote (fold_commit's vote_sign) on the local block —
+                # elementwise; shard_map's P(AGENTS_AXIS) out_spec
+                # stitches the [m] row with zero collectives
+                extras["rep_agree"] = rep_mod.agree_rows(
+                    updates, vote_sign, mask=mask_local)
+                extras["rep_norm"] = rep_mod.norm_rows(updates,
+                                                       mask=mask_local)
             return (new_params, new_astate), loss, extras
         if _pallas_applicable(cfg):
             new_params = _sharded_pallas_apply(params, updates, szs, cfg)
@@ -830,8 +878,14 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                 else:
                     lr = (cfg.effective_server_lr if knobs is None
                           else knobs.server_lr)
+                agg_out = {}
                 agg = _sharded_aggregate(updates, szs, cfg, d, noise_key,
-                                         mask_local, mask_full)
+                                         mask_local, mask_full,
+                                         out=agg_out)
+                if sign_sums is None:
+                    # thresholdless sign aggregation: the sign branch's
+                    # own psum results, re-read for the reputation lane
+                    sign_sums = agg_out.get("sign_sums")
                 new_params = apply_aggregate(params, lr, agg)
         loss, extras = _loss_and_health(cfg, losses, updates, new_params,
                                         mask_local, d)
@@ -866,6 +920,28 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
                     lr if cfg.robustLR_threshold > 0 else None, agg,
                     AGENTS_AXIS, mask_local=mask_local, mask_full=mask_full,
                     corrupt_full=corrupt_full, sign_sums=sign_sums))
+        from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
+            reputation as rep_mod)
+        if rep_mod.reputation_on(cfg):
+            if bucket_info is not None:
+                # computed inside _bucketed_apply against the full vote
+                # whose shard rode the existing result all_gather
+                rep_local = bucket_info.rep_agree
+                rep_nrm = bucket_info.rep_norm
+                if mask_local is not None:
+                    rep_local = jnp.where(mask_local, rep_local,
+                                          rep_mod.MASKED)
+                    rep_nrm = jnp.where(mask_local, rep_nrm,
+                                        rep_mod.MASKED)
+            else:
+                # leaf layout: the vote's replicated sign-sum psums,
+                # re-read — local [m/d] block, stitched to [m] by the
+                # P(AGENTS_AXIS) out_spec, zero collectives
+                rep_local = rep_mod.agree_rows(updates, sign_sums,
+                                               mask=mask_local)
+                rep_nrm = rep_mod.norm_rows(updates, mask=mask_local)
+            extras["rep_agree"] = rep_local
+            extras["rep_norm"] = rep_nrm
         if cfg.diagnostics:
             from defending_against_backdoors_with_robust_learning_rate_tpu.fl.diagnostics import (
                 per_agent_norms)
@@ -898,6 +974,15 @@ def _build_sharded_body(cfg, model, normalize, mesh, take_flags=None,
     # excludes the [m] suspect vector by construction
     extras_specs.update({k: P() for k in
                          health_sentinel.health_keys(cfg, sharded=True)})
+    # reputation lane (obs/reputation.py): each device emits its LOCAL
+    # [m/d] rep_agree + rep_norm blocks ([E, m/d] in a tenant pack) and
+    # shard_map's out_spec stitches the full [m] rows — the free
+    # materialization the health lane's hlth_agent_bad could not afford
+    # (its value is replicated; the rep lanes are sharded by construction)
+    from defending_against_backdoors_with_robust_learning_rate_tpu.obs.reputation import (
+        rep_keys)
+    extras_specs.update({k: (P(None, AGENTS_AXIS) if mt
+                             else P(AGENTS_AXIS)) for k in rep_keys(cfg)})
 
     if mt:
         # tenant axis INSIDE the shard: every input grows a leading [E]

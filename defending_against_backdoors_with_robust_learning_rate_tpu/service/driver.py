@@ -519,7 +519,9 @@ def _serve(cfg: Config, writer, max_rounds, _adapt, _adapt_reentry,
                     # ladder trigger. Replay-deduped, so a rollback's
                     # re-evaluated boundary re-emits nothing.
                     why = health_monitor.defense_anomaly(
-                        eng.mstate.get("defense"))
+                        eng.mstate.get("defense"),
+                        flip_hi=cfg.defense_flip_frac_hi,
+                        low_margin_hi=cfg.defense_low_margin_hi)
                     if why:
                         obs_events.emit(
                             "health/defense_anomaly", severity="info",
@@ -812,6 +814,25 @@ def _update_exporter(exporter, eng, sup: Supervisor, ladder,
         exporter.set("ledger_seq", ledger.seq,
                      help_text="event-ledger sequence number "
                                "(obs/events.py)")
+    susp = summ.get("suspicion")
+    if susp:
+        # defense-provenance gauges (obs/reputation.py): the fleet's
+        # scrape sees WHO the defense is flagging, not just whether it
+        # is flipping — absent entirely when --reputation off
+        exporter.set("rep_suspects", susp["suspect_count"],
+                     help_text="clients past the suspicion streak "
+                               "threshold (obs/reputation.py)")
+        exporter.set("rep_clients_tracked", susp["clients"],
+                     help_text="clients with longitudinal "
+                               "reputation state")
+        if susp.get("scores"):
+            exporter.set("rep_top_suspect_score", susp["scores"][0],
+                         help_text="highest suspicion score "
+                                   "(suspicion EMA, obs/reputation.py)")
+        if "auc" in susp:
+            exporter.set("rep_suspicion_auc", susp["auc"],
+                         help_text="suspicion ranking AUC vs known "
+                                   "corrupt ids (evaluation only)")
     cfg = eng.cfg
     if cfg.traffic_enabled and cfg.num_agents <= CENSUS_MAX_POPULATION:
         # diurnal-traffic census (data/traffic.py, ISSUE 17 follow-up):

@@ -64,7 +64,14 @@ SUMMARY_KEYS = ("round", "val_acc", "val_loss", "poison_acc", "poison_loss",
                 # went nonfinite under --health_policy record is a
                 # RECORDED verdict in the queue results, never a dead
                 # queue or a silent hole
-                "health")
+                "health",
+                # the last boundary's per-client suspicion verdict
+                # (obs/reputation.ReputationTracker.summary via
+                # train.py / service/tenancy.py): sweep cells carry
+                # which clients the defense provenance plane ranked
+                # suspect — and the ranking AUC when ground truth is
+                # known — without any extra file to join
+                "suspicion")
 
 
 def load_cells(path: str) -> List[Dict[str, Any]]:

@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from defending_against_backdoors_with_robust_learning_rate_tpu.fl import (
     buffered, tenancy as ftenancy)
@@ -58,7 +59,8 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import 
 from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
     monitor as health_monitor, sentinel as health_sentinel)
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
-    attribution as obs_attribution, telemetry as obs_telemetry)
+    attribution as obs_attribution, events as obs_events,
+    reputation as obs_reputation, telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
     compile_cache)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils.guards import (
@@ -174,6 +176,9 @@ class _Slot:
                             if self.active else [])
         self.cum_poison = 0.0
         self.health_ema = None
+        # per-tenant suspicion ledger (obs/reputation.py) — assigned by
+        # the engine when the pack program carries the rep_agree lane
+        self.rep_tracker = None
         self.summary: Dict[str, Any] = {}
         self.error: Optional[BaseException] = None
 
@@ -425,6 +430,17 @@ class PackEngine:
         # discipline: the [E, m] hlth_agent_bad suspect vector is ladder
         # evidence and must never ride the per-boundary fetch
         self.hlth_boundary = set(health_sentinel.boundary_keys(cfgs[0]))
+        # per-tenant suspicion ledgers: the pack program's [E, m]
+        # rep_agree lane fans out one tracker per cell — the solo twin's
+        # longitudinal state, sliced on the tenant axis at the boundary
+        self._rep_on = obs_reputation.reputation_on(rep)
+        self._rep_pending: List[Any] = []
+        if self._rep_on:
+            for slot in self.slots:
+                if slot.active:
+                    slot.rep_tracker = (
+                        obs_reputation.ReputationTracker.for_config(
+                            slot.cfg, population=slot.cfg.num_agents))
         self.t_steady = None
         self.r_steady = 0
         self.t_steady_end = None
@@ -468,6 +484,12 @@ class PackEngine:
         self.slots[e] = _Slot(cfg, name, offset,
                               MetricsWriter(cfg.log_dir, run_name(cfg),
                                             cfg.tensorboard))
+        if self._rep_on:
+            # a backfilled cell starts its suspicion ledger fresh — the
+            # solo twin's state at its round 0
+            self.slots[e].rep_tracker = (
+                obs_reputation.ReputationTracker.for_config(
+                    cfg, population=cfg.num_agents))
         self._refresh_knobs()
 
     def finalize_slot(self, e: int) -> Dict[str, Any]:
@@ -532,6 +554,13 @@ class PackEngine:
             ids = jnp.arange(unit[0], unit[-1] + 1)
             self.carry, stacked = self.chained_fn(
                 self.carry, self.base_keys_E, ids, self.knobs)
+            if self._rep_on and "rep_agree" in stacked:
+                # [chain, E, m] agreement + norm rows + the matching
+                # stacked client ids — sliced per tenant at the boundary
+                # fan-out
+                self._rep_pending.append((tuple(unit), stacked["sampled"],
+                                          stacked["rep_agree"],
+                                          stacked["rep_norm"]))
             return unit[-1], {k: v[-1] for k, v in stacked.items()}
         rnd = unit[0]
         keys_E = self._fold(self.base_keys_E, self.knobs.rnd_offset, rnd)
@@ -542,6 +571,10 @@ class PackEngine:
         else:
             self.carry, info = self.round_fn(self.carry, keys_E,
                                              jnp.int32(rnd), self.knobs)
+        if self._rep_on and "rep_agree" in info:
+            self._rep_pending.append(((rnd,), info["sampled"],
+                                      info["rep_agree"],
+                                      info["rep_norm"]))
         return rnd, info
 
     def params_E(self):
@@ -570,6 +603,11 @@ class PackEngine:
             vals["churn_away"] = info["churn_away"]
         vals.update({k: info[k] for k in info
                      if k.startswith("tel_") or k in self.hlth_boundary})
+        if self._rep_pending:
+            # per-pack-round (round_ids, client_ids, rep_agree, rep_norm)
+            # stacks since the last boundary ride the same (async) fetch
+            vals["rep_rows"] = self._rep_pending
+            self._rep_pending = []
         if self.drain is not None:
             self.drain.submit(self._emit_all, vals, rnd, rounds_done,
                               elapsed)
@@ -590,13 +628,16 @@ class PackEngine:
             # --health off keeps the historical pack-level endpoint
             finite_warn(vals["finite"], where=f"pack round {pack_rnd}")
         now = time.perf_counter()
+        # popped ONCE so an evict/retry pass cannot double-fold the
+        # per-tenant ledgers (the solo _emit_eval_body discipline)
+        rep_rows = vals.pop("rep_rows", None)
         errors: Dict[int, BaseException] = {}
         for e, slot in enumerate(self.slots):
             if not slot.active:
                 continue
             try:
                 self._emit_slot(e, slot, vals, pack_rnd, rounds_done_now,
-                                elapsed, now, lane_on)
+                                elapsed, now, lane_on, rep_rows)
             except Exception as err:
                 if not self.evict_on_anomaly:
                     raise
@@ -611,7 +652,7 @@ class PackEngine:
 
     def _emit_slot(self, e: int, slot: _Slot, vals, pack_rnd: int,
                    rounds_done_now: int, elapsed: float, now: float,
-                   lane_on: bool) -> None:
+                   lane_on: bool, rep_rows=None) -> None:
         writer, cfg = slot.writer, slot.cfg
         ernd = pack_rnd + slot.offset  # the slot's own round index
         report = None
@@ -659,6 +700,28 @@ class PackEngine:
                           float(vals["churn_away"][e]), ernd)
         tel = obs_telemetry.tenant_rows(vals, e, allowed=slot.tel_allowed)
         obs_telemetry.emit_scalars(writer, tel, ernd)
+        rep_pred = ((lambda cid: cid < cfg.num_corrupt)
+                    if cfg.num_corrupt > 0 else None)
+        if slot.rep_tracker is not None and rep_rows:
+            # the tenant's slice of the pack's [.., E, m] agreement rows
+            # folds into ITS ledger on ITS clock (ernd = pack + offset),
+            # mirroring the solo fold order; rows land after Defense/*
+            # and before Throughput/*, the solo row order
+            tracker = slot.rep_tracker
+            for rnds, ids_blk, agrees, norms in rep_rows:
+                ids_blk, agrees = np.asarray(ids_blk), np.asarray(agrees)
+                norms = np.asarray(norms)
+                if agrees.ndim == 2:             # single round [E, m]
+                    tracker.fold(rnds[0] + slot.offset, ids_blk[e],
+                                 agrees[e], norms[e])
+                else:                            # chained [chain, E, m]
+                    for j, r in enumerate(rnds):
+                        tracker.fold(r + slot.offset, ids_blk[j, e],
+                                     agrees[j, e], norms[j, e])
+            obs_reputation.emit_rows(writer, tracker, ernd, rep_pred)
+            for ev in tracker.drain_events():
+                obs_events.emit(obs_reputation.SUSPECT_EVENT,
+                                severity="warn", tenant=e, **ev)
         writer.scalar("Throughput/Rounds_Per_Sec",
                       rounds_done_now / elapsed, ernd)
         if (self.t_steady is not None
@@ -672,6 +735,18 @@ class PackEngine:
             "rounds_per_sec": rounds_done_now / elapsed}
         if tel:
             summary["defense"] = obs_telemetry.host_summary(tel)
+        if slot.rep_tracker is not None:
+            # the suspicion verdict as data: the same per-cell summary
+            # key the solo path records (train.py _emit_eval_body), so
+            # queue/sweep rows stay structurally identical packed or
+            # serial (service/queue.SUMMARY_KEYS "suspicion")
+            rep_sum = slot.rep_tracker.summary(rep_pred)
+            summary["suspicion"] = rep_sum
+            if "defense" in summary:
+                summary["defense"]["rep_suspects"] = float(
+                    rep_sum["suspect_count"])
+                if "auc" in rep_sum:
+                    summary["defense"]["rep_auc"] = float(rep_sum["auc"])
         if report is not None and report["rows"]:
             # the lane's verdict as data: queue rows
             # (service/queue.SUMMARY_KEYS) record per-cell health —

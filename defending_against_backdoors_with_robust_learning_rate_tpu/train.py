@@ -48,7 +48,7 @@ from defending_against_backdoors_with_robust_learning_rate_tpu.health import (
 from defending_against_backdoors_with_robust_learning_rate_tpu.obs import (
     Heartbeat, NullHeartbeat, SpanTracer, attribution as obs_attribution,
     events as obs_events, flight as obs_flight,
-    telemetry as obs_telemetry)
+    reputation as obs_reputation, telemetry as obs_telemetry)
 from defending_against_backdoors_with_robust_learning_rate_tpu.models.registry import (
     get_model, init_params, param_count)
 from defending_against_backdoors_with_robust_learning_rate_tpu.utils import (
@@ -242,6 +242,16 @@ class RoundEngine:
         if cfg.telemetry != "off":
             print(f"[telemetry] in-jit defense telemetry: {cfg.telemetry} "
                   f"(Defense/* scalars ride the metrics stream)")
+        # reputation-plane validation (obs/reputation.py), loudly and
+        # before any build; the lane itself resolves after the pallas
+        # decision (`auto` rides the jnp paths only)
+        obs_reputation.check(cfg)
+        self._rep_on = obs_reputation.reputation_on(cfg)
+        if self._rep_on:
+            print(f"[reputation] per-client suspicion lanes: rep_agree + "
+                  f"rep_norm ride the round program (zero added "
+                  f"collectives); host ledger keyed by real client ids "
+                  f"(--reputation off disables)")
         # persistent XLA cache + AOT executable bank — must be configured
         # before the first compile so every program family persists
         bank = compile_cache.setup(cfg)
@@ -795,6 +805,24 @@ class RoundEngine:
 
         start_round, cum_poison_acc, self.cum_net_mov = 0, 0.0, 0.0
         health_ema = None
+        # per-client suspicion ledger (obs/reputation.py): the host fold
+        # of the in-jit rep_agree lane — lead process only (the writer's
+        # discipline); every process still COMPILES the lane so program
+        # families match across the pod. Observe-only: quarantine stays
+        # the health ladder's decision.
+        self._rep_tracker = (obs_reputation.ReputationTracker.for_config(
+            cfg, population=cfg.num_agents)
+            if self._rep_on and lead else None)
+        self._rep_pending = []
+        # ground truth touches ONLY the AUC evaluation row — the ranking
+        # itself never reads a corrupt flag (obs/reputation.py)
+        self._rep_pred = ((lambda cid: cid < cfg.num_corrupt)
+                          if cfg.num_corrupt > 0 else None)
+        if self._rep_tracker is not None and self._rep_tracker.sketch_mode:
+            print(f"[reputation] population {cfg.num_agents:,} > cap "
+                  f"{cfg.rep_population_cap:,}: count-min sketch + "
+                  f"top-{cfg.rep_topk} heavy-hitter ledger "
+                  f"(O(cohort + k) RSS)")
         if cfg.resume and cfg.checkpoint_dir:
             restored = ckpt.restore(
                 cfg.checkpoint_dir, params, upto=self._resume_upto,
@@ -815,6 +843,12 @@ class RoundEngine:
                 for entry in ckpt.journal_read(cfg.checkpoint_dir):
                     if entry["round"] == start_round:
                         health_ema = entry.get("health") or None
+                        # the suspicion ledger rides the same journal
+                        # entry; restoring it is what keeps replayed
+                        # Reputation/* rows byte-identical
+                        if self._rep_tracker is not None:
+                            self._rep_tracker.load_state(
+                                entry.get("reputation") or None)
                 print(f"[ckpt] resumed from round {start_round}")
                 # a per-life record (obs/events.PER_LIFE_PREFIXES): each
                 # process/segment that restores emits its own — a no-op
@@ -1095,6 +1129,20 @@ class RoundEngine:
                          if k in stacked})
             info.update({k: stacked[k][-1] for k in stacked
                          if k.startswith(("tel_", "hlth_"))})
+            if self._rep_tracker is not None and "rep_agree" in stacked:
+                # [chain, m] agreement rows + matching REAL client ids:
+                # device-resident scans stack their in-program draw
+                # ("sampled"); host/cohort blocks don't carry it through
+                # the scan — the payload's id block is the bit-identical
+                # host mirror. Rows stay on device until the boundary's
+                # (async) drain fetch.
+                ids_blk = stacked.get("sampled")
+                if ids_blk is None and payload is not None:
+                    ids_blk = payload[0]
+                if ids_blk is not None:
+                    self._rep_pending.append((tuple(unit), ids_blk,
+                                              stacked["rep_agree"],
+                                              stacked["rep_norm"]))
             self._want_diag, self._prev_params = False, None
         else:
             rnd = unit[0]
@@ -1119,6 +1167,16 @@ class RoundEngine:
                                            *self._round_lead(rnd))
             self.rnd = rnd
             self.rounds_done += 1
+            if (self._rep_tracker is not None and "rep_agree" in info
+                    and "sampled" in info):
+                if nonce:
+                    # DISCARD-rung re-dispatch: the withdrawn attempt's
+                    # evidence must not fold alongside the redrawn round
+                    self._rep_pending = [p for p in self._rep_pending
+                                         if p[0] != (rnd,)]
+                self._rep_pending.append(((rnd,), info["sampled"],
+                                          info["rep_agree"],
+                                          info["rep_norm"]))
         self._last_info = info
         if self.prof is not None:
             # accounts the unit toward the capture budget and polls the
@@ -1234,6 +1292,13 @@ class RoundEngine:
         vals.update({k: info[k]
                      for k in health_sentinel.boundary_keys(cfg)
                      if k in info})
+        if self._rep_tracker is not None and self._rep_pending:
+            # per-round (round_ids, client_ids, rep_agree, rep_norm) rows
+            # since the last boundary ride the same (async) fetch; the
+            # tracker fold happens host-side in _emit_eval_body, on the
+            # drain thread in async mode
+            vals["rep_rows"] = self._rep_pending
+            self._rep_pending = []
         if self.drain is not None:
             elapsed = time.perf_counter() - self.t_loop
             self.drain.submit(self._emit_eval, vals, rnd, self.rounds_done,
@@ -1322,6 +1387,28 @@ class RoundEngine:
         # Defense/* telemetry scalars (obs/telemetry.py), shared emit path
         # so sync and async streams stay bit-identical
         obs_telemetry.emit_scalars(writer, vals, ernd)
+        # suspicion ledger fold + Reputation/* rows (obs/reputation.py):
+        # popped so a supervised retry of this body cannot double-fold
+        # the longitudinal EMA/streak state
+        rep_rows = vals.pop("rep_rows", None)
+        if self._rep_tracker is not None and rep_rows is not None:
+            tracker = self._rep_tracker
+            for rnds, row_ids, agrees, norms in rep_rows:
+                row_ids, agrees = np.asarray(row_ids), np.asarray(agrees)
+                norms = np.asarray(norms)
+                if agrees.ndim == 1:
+                    tracker.fold(rnds[0], row_ids, agrees, norms)
+                else:
+                    for j, r in enumerate(rnds):
+                        tracker.fold(r, row_ids[j], agrees[j], norms[j])
+            obs_reputation.emit_rows(writer, tracker, ernd,
+                                     self._rep_pred)
+            for ev in tracker.drain_events():
+                # typed ledger event on the streak crossing; replay-
+                # deduped (obs/events.REPLAY_DEDUPE_EVENTS) so crash-
+                # exact resumes don't re-announce the same suspect
+                obs_events.emit(obs_reputation.SUSPECT_EVENT,
+                                severity="warn", **ev)
         writer.scalar("Throughput/Rounds_Per_Sec",
                       rounds_done_now / elapsed, ernd)
         now = time.perf_counter()
@@ -1359,6 +1446,18 @@ class RoundEngine:
             # let the adaptation controller decide on the previous
             # boundary's snapshot (service/driver.py checks this)
             mstate["defense_round"] = ernd
+        if self._rep_tracker is not None:
+            rep_sum = self._rep_tracker.summary(self._rep_pred)
+            # the queue/sweep cells read this key (service/queue.py
+            # SUMMARY_KEYS "suspicion")
+            mstate["summary"]["suspicion"] = rep_sum
+            if tel:
+                # scalar enrichment of the defense block — float values
+                # only, so consumers that iterate the block's rows
+                # (attack/adapt.py) stay type-stable
+                tel["rep_suspects"] = float(rep_sum["suspect_count"])
+                if "auc" in rep_sum:
+                    tel["rep_auc"] = float(rep_sum["auc"])
         if mstate["t_steady"] is None:
             # first eval boundary done: every program variant on the hot
             # path has now compiled (or loaded) at least once
@@ -1415,9 +1514,15 @@ class RoundEngine:
                 # the health-EMA baseline rides the journal entry: a
                 # crash-exact resume restores it alongside the metrics
                 # splice so replayed Health/* rows are byte-identical
+                extra = {"health": self.mstate["health_ema"]}
+                if self._rep_tracker is not None:
+                    # the suspicion ledger rides the same entry
+                    # (crash-exact Reputation/* rows); keyed only when
+                    # the lane is on, so an off run's journal is
+                    # byte-identical to the pre-plane format
+                    extra["reputation"] = self._rep_tracker.state_dict()
                 ckpt.journal_record(cfg.checkpoint_dir, rnd, offset(),
-                                    keep_last=keep,
-                                    health=self.mstate["health_ema"])
+                                    keep_last=keep, **extra)
 
     def post_unit(self) -> None:
         """End-of-unit bookkeeping: flip the compile flag after the first

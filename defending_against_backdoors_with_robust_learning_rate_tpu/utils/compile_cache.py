@@ -135,6 +135,13 @@ EXCLUDED_FIELDS = frozenset({
     # traced (attack/registry.py update hook + schedule) and stay in the
     # fingerprint.
     "rlr_adapt", "rlr_adapt_every",
+    # defense provenance plane (ISSUE 20): the host tracker's
+    # representation knobs and the health ladder's promoted anomaly
+    # thresholds are never read in a trace (`reputation` by contrast
+    # selects whether the rep_* lanes are compiled in and stays in the
+    # fingerprint, the `telemetry` rule)
+    "rep_population_cap", "rep_topk", "rep_streak",
+    "defense_flip_frac_hi", "defense_low_margin_hi",
     # NOT here: `agg_layout` (ISSUE 8). It selects the sharded
     # aggregation program (per-leaf psums vs bucketed reduce-scatter,
     # parallel/rounds.py reads it at trace time), so it must stay in the

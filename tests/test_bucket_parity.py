@@ -152,19 +152,23 @@ def _setup(dtype="f32", **kw):
 VARIANTS = {
     "avg_rlr": dict(aggr="avg", robustLR_threshold=3),
     "sign_rlr": dict(aggr="sign", robustLR_threshold=3, server_lr=0.5),
-    "avg_rlr_faults": dict(aggr="avg", robustLR_threshold=3,
-                           dropout_rate=0.3, payload_norm_cap=100.0,
-                           faults_spare_corrupt=True),
 }
 
-# tier-1 re-budget (ISSUE 10): the full-telemetry variant rides the slow
-# tier — its cheap twins are the three tier-1 variants above plus the CI
-# `bucket-parity` smoke (which byte-compares a FULL-telemetry run's
-# metrics stream across layouts) and the telemetry-collective contract
-# pins (sharded_rlr_avg_bucket_tel_full in analysis_baseline.json)
+# tier-1 re-budget (ISSUE 10/20): the full-telemetry and faults
+# variants ride the slow tier — their cheap twins are the two tier-1
+# variants above (the layout crossing itself), the CI `bucket-parity`
+# smoke (which byte-compares a FULL-telemetry run's metrics stream
+# across layouts), the megabatch faults parity
+# (test_megabatch.test_round_parity_faults — the identical draw/mask
+# arithmetic on another layout crossing), and the collective-contract
+# pins (sharded_rlr_avg_bucket_tel_full / sharded_rlr_avg_bucket_faults
+# in analysis_baseline.json)
 SLOW_VARIANTS = {
     "avg_rlr_tel_full": dict(aggr="avg", robustLR_threshold=3,
                              telemetry="full"),
+    "avg_rlr_faults": dict(aggr="avg", robustLR_threshold=3,
+                           dropout_rate=0.3, payload_norm_cap=100.0,
+                           faults_spare_corrupt=True),
 }
 
 # series whose bucket-path values are integer-count arithmetic on the

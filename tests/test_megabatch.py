@@ -70,9 +70,10 @@ def leaf_scale_ulps(t1, t2) -> float:
     return worst
 
 
-def _setup(dtype="f32", m=6, local_ep=2, **kw):
+def _setup(dtype="f32", m=6, local_ep=2, synth_train_size=256, **kw):
     cfg = Config(data="synthetic", num_agents=m, bs=16, local_ep=local_ep,
-                 synth_train_size=256, synth_val_size=64, eval_bs=32,
+                 synth_train_size=synth_train_size, synth_val_size=64,
+                 eval_bs=32,
                  num_corrupt=2, poison_frac=1.0, seed=11, dtype=dtype,
                  robustLR_threshold=3, **kw)
     fed = get_federated_data(cfg)
@@ -117,10 +118,38 @@ def test_masked_ce_segments_is_the_per_client_reduction():
         np.asarray(wn), np.asarray(weights.sum(axis=1), np.float32))
 
 
+def test_trainer_parity_f32_small():
+    """Cheap tier-1 twin of the slow-gated
+    ``test_trainer_parity_f32_with_pgd_and_chunk``: the same three
+    assertions (update-pytree ulp bound, chunked fold parity, the
+    invalid-chunk error) on a quarter-size schedule — the fold, mask
+    and chunk arithmetic are schedule-length-independent; the full
+    2-epoch PGD schedule stays pinned behind -m slow."""
+    cfg, model, params, norm, (imgs, lbls, szs) = _setup(
+        m=4, local_ep=1, synth_train_size=96, clip=5.0)
+    m = cfg.num_agents
+    keys = jax.random.split(jax.random.PRNGKey(7), m)
+    lt, mb = _both_trainers(cfg, model, norm)
+    u1, l1 = jax.jit(lambda *a: vmap_agents(lt, *a))(
+        params, imgs, lbls, szs, keys)
+    u2, l2 = jax.jit(lambda *a: megabatch_agents(mb, *a))(
+        params, imgs, lbls, szs, keys)
+    assert leaf_scale_ulps(u1, u2) <= ULP_BOUND
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-5, atol=1e-7)
+    u3, _ = jax.jit(lambda *a: megabatch_agents(mb, *a, chunk=2))(
+        params, imgs, lbls, szs, keys)
+    assert leaf_scale_ulps(u2, u3) <= ULP_BOUND
+    with pytest.raises(ValueError, match="agent_chunk"):
+        megabatch_agents(mb, params, imgs, lbls, szs, keys, chunk=3)
+
+
+@pytest.mark.slow
 def test_trainer_parity_f32_with_pgd_and_chunk():
     """Per-client update pytrees: megabatch vs vmap within ULP_BOUND
     leaf-scale ulps, per-client losses ulp-close; chunked megabatch
-    (the HBM lever) equals the full fold within the same bound."""
+    (the HBM lever) equals the full fold within the same bound.
+    Slow-gated: ``test_trainer_parity_f32_small`` is the tier-1 twin."""
     cfg, model, params, norm, (imgs, lbls, szs) = _setup(clip=5.0)
     m = cfg.num_agents
     keys = jax.random.split(jax.random.PRNGKey(7), m)
@@ -142,12 +171,39 @@ def test_trainer_parity_f32_with_pgd_and_chunk():
         megabatch_agents(mb, params, imgs, lbls, szs, keys, chunk=4)
 
 
+def test_straggler_segment_masking_small():
+    """Cheap tier-1 twin of the slow-gated
+    ``test_straggler_segment_masking_equals_masked_step``: mid-schedule
+    truncation AND the zero-budget exact no-op in one quarter-size run
+    (budgets [2,1,0,2] exercise full/truncated/absent clients at once);
+    the full-size schedule stays behind -m slow."""
+    cfg, model, params, norm, (imgs, lbls, szs) = _setup(
+        m=4, synth_train_size=96, straggler_rate=0.5, straggler_epochs=1)
+    keys = jax.random.split(jax.random.PRNGKey(5), cfg.num_agents)
+    budgets = jnp.array([2, 1, 0, 2], jnp.int32)
+    lt, mb = _both_trainers(cfg, model, norm)
+    u1, l1 = jax.jit(lambda *a: vmap_agents(lt, *a[:-1], ep_budget=a[-1]))(
+        params, imgs, lbls, szs, keys, budgets)
+    u2, l2 = jax.jit(
+        lambda *a: megabatch_agents(mb, *a[:-1], ep_budget=a[-1]))(
+        params, imgs, lbls, szs, keys, budgets)
+    assert leaf_scale_ulps(u1, u2) <= ULP_BOUND
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-5, atol=1e-7)
+    # the budget-0 client is an exact no-op on both layouts
+    for u in (u1, u2):
+        for leaf in jax.tree_util.tree_leaves(u):
+            np.testing.assert_array_equal(np.asarray(leaf)[2], 0.0)
+
+
+@pytest.mark.slow
 def test_straggler_segment_masking_equals_masked_step():
     """Folding the per-client step masks into the segment weights must
     equal the vmap layout's per-client masked step: clients truncated
     mid-schedule (epoch budgets 1 of 2) contribute exactly their
     completed epochs (losses ulp-close — later steps read ulp-shifted
-    params)."""
+    params). Slow-gated: ``test_straggler_segment_masking_small`` is
+    the tier-1 twin."""
     cfg, model, params, norm, (imgs, lbls, szs) = _setup(
         straggler_rate=0.5, straggler_epochs=1)
     m = cfg.num_agents
@@ -225,10 +281,39 @@ def test_round_parity_faults():
                                float(i2["train_loss"]), rtol=1e-6)
 
 
+def test_chained_adopts_megabatch_small():
+    """Cheap tier-1 twin of the slow-gated
+    ``test_chained_adopts_megabatch_unchanged``: the same 2-round
+    chained_mb vs per-round round_mb comparison on a quarter-size
+    setup — block adoption is a program-structure property, not a
+    schedule-length one; the full-size run stays behind -m slow."""
+    from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
+        make_chained_round_fn)
+    cfg, model, params, norm, arrays = _setup(
+        m=4, local_ep=1, synth_train_size=96)
+    mcfg = cfg.replace(train_layout="megabatch")
+    base = jax.random.PRNGKey(9)
+    fn = make_round_fn(mcfg, model, norm, *arrays)
+    p_seq = params
+    for r in (1, 2):
+        p_seq, _ = fn(p_seq, jax.random.fold_in(base, r))
+    chained = make_chained_round_fn(mcfg, model, norm, *arrays)
+    assert chained.family == "chained_mb"
+    p_blk, info = chained(params, base, jnp.arange(1, 3))
+    assert info["train_loss"].shape == (2,)
+    for a, b in zip(jax.tree_util.tree_leaves(p_seq),
+                    jax.tree_util.tree_leaves(p_blk), strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow
 def test_chained_adopts_megabatch_unchanged():
     """The chained lax.scan block adopts the megabatch step unchanged:
     a 2-round chained_mb block matches two per-round round_mb dispatches
-    (the driver-loop key derivation, ~1 ulp fusion differences)."""
+    (the driver-loop key derivation, ~1 ulp fusion differences).
+    Slow-gated: ``test_chained_adopts_megabatch_small`` is the tier-1
+    twin."""
     from defending_against_backdoors_with_robust_learning_rate_tpu.fl.rounds import (
         make_chained_round_fn)
     cfg, model, params, norm, arrays = _setup(local_ep=1)
